@@ -1,0 +1,23 @@
+"""TPU-native distributed training framework.
+
+A ground-up JAX/XLA rebuild of the capability surface of
+``rubythonode/DistributedTensorFlowExample`` (see SURVEY.md — the reference
+tree was empty at survey time, so parity is against the driver-pinned
+capability contract in BASELINE.json, not file:line citations):
+
+* local single-process MNIST softmax training          (config 1)
+* async parameter-server MNIST CNN training            (config 2)
+* sync-SGD (SyncReplicasOptimizer-style) MNIST CNN     (config 3)
+* single-host data-parallel CIFAR-10 ResNet-20         (config 4)
+* multi-host data-parallel CIFAR-10 ResNet-20          (config 5)
+
+Design stance (BASELINE.json north star): one SPMD core replaces all four
+distribution mechanisms of the reference.  Parameters are never "placed on a
+parameter server" — they live replicated (or sharded) per ``NamedSharding``
+on a ``jax.sharding.Mesh``; gradient combination is an XLA collective inside
+a jitted step; multi-host is the same program on more processes.
+"""
+
+from distributedtensorflowexample_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,112 @@
+"""Run configuration and the reference-compatible CLI flag surface.
+
+The reference's trainer.py scripts expose TF-1.x cluster flags
+(``--job_name --task_index --ps_hosts --worker_hosts``) plus the usual
+hyper-parameter flags (capability contract: BASELINE.json "configs" +
+north-star "existing trainer.py entrypoints keep their CLI").  We keep every
+flag name; the cluster-topology flags no longer spawn gRPC processes — they
+are resolved onto a single SPMD mesh spec (see ``cluster.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Everything a trainer needs, parsed from flags.
+
+    Mirrors the flag surface of the reference scripts; cluster fields are
+    compatibility aliases interpreted by :mod:`..cluster` rather than a
+    description of real parameter-server processes.
+    """
+
+    # --- cluster compatibility flags (reference: tf.train.ClusterSpec) ---
+    job_name: str = ""              # "", "ps", "worker"
+    task_index: int = 0
+    ps_hosts: str = ""              # comma-separated host:port (compat alias)
+    worker_hosts: str = ""          # comma-separated host:port (compat alias)
+
+    # --- multi-host bootstrap (replaces TF_CONFIG / tf.train.Server) ---
+    coordinator_address: str = ""   # host:port of process 0; "" = single host
+    num_processes: int = 1
+    process_id: int = -1            # -1 = derive from task_index
+
+    # --- training hyper-parameters ---
+    batch_size: int = 100           # per-replica batch size (reference semantics:
+                                    # per-worker batching; global = batch*replicas)
+    global_batch: bool = False      # if True, batch_size is the global batch
+    train_steps: int = 1000
+    learning_rate: float = 0.01
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    lr_schedule: str = "constant"   # constant | cosine | step
+    warmup_steps: int = 0
+    dropout: float = 0.5
+    label_smoothing: float = 0.0
+    seed: int = 0
+
+    # --- data / logging ---
+    data_dir: str = "/tmp/data"
+    log_dir: str = "/tmp/train_logs"
+    dataset: str = "mnist"          # mnist | cifar10 | synthetic
+    eval_every: int = 0             # 0 = eval only at end
+    log_every: int = 100
+    checkpoint_every: int = 0       # 0 = no periodic checkpoints
+    keep_checkpoints: int = 3
+    resume: bool = True             # auto-restore latest checkpoint if present
+
+    # --- parallelism ---
+    num_devices: int = 0            # 0 = all visible devices
+    sync_mode: str = "sync"         # sync | async (async = local-SGD emulation)
+    async_period: int = 8           # param-averaging period for async emulation
+    replicas_to_aggregate: int = 0  # SyncReplicasOptimizer compat; 0 = all
+    dtype: str = "bfloat16"         # compute dtype on TPU (params stay f32)
+
+    @property
+    def ps_host_list(self) -> list[str]:
+        return [h for h in self.ps_hosts.split(",") if h]
+
+    @property
+    def worker_host_list(self) -> list[str]:
+        return [h for h in self.worker_hosts.split(",") if h]
+
+
+def build_parser(description: str = "TPU-native trainer") -> argparse.ArgumentParser:
+    """Argparse parser exposing the full reference-compatible flag surface."""
+    p = argparse.ArgumentParser(description=description)
+    fields = {f.name: f for f in dataclasses.fields(RunConfig)}
+    for name, f in fields.items():
+        arg = "--" + name
+        if f.type in ("bool", bool):
+            p.add_argument(arg, type=_str2bool, default=f.default,
+                           help=f"(default: {f.default})")
+        else:
+            typ = {"int": int, "float": float, "str": str}.get(str(f.type), str)
+            if isinstance(f.default, int) and not isinstance(f.default, bool):
+                typ = int
+            elif isinstance(f.default, float):
+                typ = float
+            p.add_argument(arg, type=typ, default=f.default,
+                           help=f"(default: {f.default})")
+    return p
+
+
+def _str2bool(v: str) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "t", "yes", "y")
+
+
+def parse_flags(argv: Sequence[str] | None = None,
+                description: str = "TPU-native trainer",
+                **overrides) -> RunConfig:
+    """Parse argv into a RunConfig; ``overrides`` win over defaults."""
+    parser = build_parser(description)
+    parser.set_defaults(**overrides)
+    ns, _ = parser.parse_known_args(argv)
+    kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(RunConfig)}
+    return RunConfig(**kwargs)

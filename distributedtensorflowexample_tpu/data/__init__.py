@@ -1,0 +1,5 @@
+from distributedtensorflowexample_tpu.data.mnist import load_mnist
+from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
+from distributedtensorflowexample_tpu.data.pipeline import Batcher, DevicePrefetcher
+
+__all__ = ["load_mnist", "load_cifar10", "Batcher", "DevicePrefetcher"]

@@ -1,0 +1,82 @@
+"""CIFAR-10 input pipeline (component C11 in SURVEY.md §2).
+
+Reference behavior [RECONSTRUCTED]: ``tf.data``/``tf.keras.datasets`` loading
+with crop/flip augmentation and per-replica sharding under the distribution
+strategies.  Rebuild: pure-numpy parsing of the canonical CIFAR-10 binary
+batches, numpy-side augmentation (random crop with 4px pad + horizontal
+flip), synthetic fallback when the bytes are absent.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], dtype=np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], dtype=np.float32)
+_SYNTH_SIZES = {"train": 50000, "test": 10000}
+
+
+def _load_binary_batches(data_dir: str, split: str):
+    """Parse CIFAR-10 in either the python-pickle or plain binary layout."""
+    base = None
+    for cand in (data_dir, os.path.join(data_dir, "cifar-10-batches-py"),
+                 os.path.join(data_dir, "cifar-10-batches-bin")):
+        if os.path.isdir(cand) and any(
+                n.startswith(("data_batch", "test_batch")) for n in os.listdir(cand)):
+            base = cand
+            break
+    if base is None:
+        return None
+    names = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    images, labels = [], []
+    for name in names:
+        path = os.path.join(base, name)
+        if os.path.exists(path):          # python pickle layout
+            with open(path, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            images.append(np.asarray(d[b"data"], dtype=np.uint8))
+            labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+        elif os.path.exists(path + ".bin"):  # binary layout: 1 label byte + 3072
+            raw = np.fromfile(path + ".bin", dtype=np.uint8).reshape(-1, 3073)
+            labels.append(raw[:, 0].astype(np.int32))
+            images.append(raw[:, 1:])
+        else:
+            return None
+    images = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return images.astype(np.float32) / 255.0, np.concatenate(labels)
+
+
+def load_cifar10(data_dir: str, split: str = "train",
+                 synthetic_size: int | None = None, seed: int = 0,
+                 normalize: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """Return (images [N,32,32,3] float32, labels [N] int32)."""
+    loaded = _load_binary_batches(data_dir, split)
+    if loaded is None:
+        num = synthetic_size or _SYNTH_SIZES[split]
+        loaded = make_synthetic(num, (32, 32, 3), 10, seed=seed,
+                                sample_seed=seed * 2 + (1 if split == "train" else 2))
+    images, labels = loaded
+    if normalize:
+        images = (images - CIFAR10_MEAN) / CIFAR10_STD
+    return images, labels
+
+
+def augment(images: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+    """Random 4px-pad crop + horizontal flip, the reference's augmentations."""
+    n, h, w, c = images.shape
+    padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    out = np.empty_like(images)
+    ys = rng.randint(0, 9, size=n)
+    xs = rng.randint(0, 9, size=n)
+    flips = rng.rand(n) < 0.5
+    for i in range(n):
+        crop = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        out[i] = crop[:, ::-1] if flips[i] else crop
+    return out

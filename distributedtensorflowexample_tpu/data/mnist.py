@@ -1,0 +1,67 @@
+"""MNIST input pipeline (component C10 in SURVEY.md §2).
+
+Reference behavior [RECONSTRUCTED — reference tree was empty]: an
+``input_data.read_data_sets(data_dir)``-style download + minibatch feed.
+TPU-native rebuild: pure-numpy IDX parsing with no TF dependency; if the
+standard IDX files are absent (no network in this environment) we fall back
+to deterministic synthetic data with the same shapes (see ``synthetic.py``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+
+_FILES = {
+    "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+_SYNTH_SIZES = {"train": 60000, "test": 10000}
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"bad IDX image magic {magic} in {path}")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows, cols, 1).astype(np.float32) / 255.0
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"bad IDX label magic {magic} in {path}")
+        return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int32)
+
+
+def load_mnist(data_dir: str, split: str = "train",
+               synthetic_size: int | None = None,
+               seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Return (images [N,28,28,1] float32 in [0,1], labels [N] int32).
+
+    Reads standard IDX(.gz) files from ``data_dir`` when present, otherwise
+    generates deterministic synthetic data of the canonical split sizes.
+    """
+    img_name, lbl_name = _FILES[split]
+    img_path = os.path.join(data_dir, img_name)
+    lbl_path = os.path.join(data_dir, lbl_name)
+    if os.path.exists(img_path) or os.path.exists(img_path + ".gz"):
+        return _read_idx_images(img_path), _read_idx_labels(lbl_path)
+    num = synthetic_size or _SYNTH_SIZES[split]
+    # Same class templates for both splits; disjoint sample draws — so a
+    # model trained on "train" genuinely generalizes to "test".
+    return make_synthetic(num, (28, 28, 1), 10, seed=seed,
+                          sample_seed=seed * 2 + (1 if split == "train" else 2))

@@ -1,0 +1,111 @@
+"""Host-side batching + device prefetch.
+
+Replaces the reference's feed_dict / tf.data input path.  At MNIST's tiny
+per-step compute the input pipeline is the scaling hazard (SURVEY.md §7
+"hard parts"), so batches are (a) assembled with pure-numpy gather (no
+per-example Python), (b) sharded per-process for multi-host, and (c)
+``jax.device_put`` ahead of the step onto the batch ``NamedSharding`` so the
+jitted step never blocks on host→HBM transfer.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import jax
+import numpy as np
+
+
+class Batcher:
+    """Infinite shuffled minibatch stream over an in-memory array pair.
+
+    ``process_index/process_count`` give each host a disjoint shard of every
+    global batch — the per-worker sharding MultiWorkerMirroredStrategy did
+    for the reference (SURVEY.md §3d).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, seed: int = 0, shuffle: bool = True,
+                 process_index: int = 0, process_count: int = 1,
+                 augment_fn: Callable[[np.ndarray, np.random.RandomState],
+                                      np.ndarray] | None = None):
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {process_count} processes")
+        self._images = images
+        self._labels = labels
+        self._global_batch = batch_size
+        self._local_batch = batch_size // process_count
+        self._rng = np.random.RandomState(seed)
+        self._shuffle = shuffle
+        self._pidx = process_index
+        self._pcount = process_count
+        self._augment = augment_fn
+        self._order = np.arange(len(images))
+        self._pos = 0
+        self._epoch = 0
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def local_batch_size(self) -> int:
+        return self._local_batch
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        # Draw a global batch of indices (all processes draw identically from
+        # the same seed), then keep only this process's contiguous slice.
+        if self._pos + self._global_batch > len(self._order):
+            self._epoch += 1
+            self._pos = 0
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+        idx = self._order[self._pos:self._pos + self._global_batch]
+        self._pos += self._global_batch
+        lo = self._pidx * self._local_batch
+        idx = idx[lo:lo + self._local_batch]
+        images = self._images[idx]
+        if self._augment is not None:
+            images = self._augment(images, self._rng)
+        return {"image": images, "label": self._labels[idx]}
+
+
+class DevicePrefetcher:
+    """Keep ``depth`` batches in flight on device ahead of the train step.
+
+    ``device_put`` with a ``Sharding`` starts the async host→HBM copy; by the
+    time the step consumes a batch the transfer has overlapped with the
+    previous step's compute.  This is the JAX-native replacement for the
+    feed_dict copy called out in SURVEY.md §3a as the per-step overhead.
+    """
+
+    def __init__(self, it: Iterator[dict[str, np.ndarray]],
+                 sharding: jax.sharding.Sharding | None = None, depth: int = 2):
+        self._it = it
+        self._sharding = sharding
+        self._buf: collections.deque = collections.deque()
+        self._depth = max(1, depth)
+
+    def _put(self, batch):
+        if self._sharding is None:
+            return jax.device_put(batch)
+        if jax.process_count() > 1:
+            # Multi-host: each process holds only its local shard of the
+            # global batch; assemble the global array from per-process data
+            # (device_put would wrongly treat the local shard as the whole
+            # global array).
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    self._sharding, x), batch)
+        return jax.device_put(batch, self._sharding)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while len(self._buf) < self._depth:
+            self._buf.append(self._put(next(self._it)))
+        return self._buf.popleft()

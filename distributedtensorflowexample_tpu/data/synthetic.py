@@ -1,0 +1,31 @@
+"""Deterministic synthetic classification data.
+
+The reference relied on network downloads (``input_data.read_data_sets`` /
+``tf.keras.datasets``).  This environment has no network, so every loader
+falls back to a deterministic, *learnable* synthetic distribution: each class
+is a fixed random template and samples are noisy blends of their class
+template.  Linear models reach high accuracy on it, which keeps the reference's
+implicit run-to-verify convergence checks meaningful without the real bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_synthetic(num: int, shape: tuple[int, ...], num_classes: int,
+                   seed: int, noise: float = 0.35,
+                   sample_seed: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Class-template images in [0,1] float32 + int32 labels.
+
+    ``seed`` fixes the class templates (the learnable structure); splits that
+    must generalize to each other share ``seed`` and differ in
+    ``sample_seed`` (which labels are drawn and which noise is added).
+    """
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(num_classes, *shape).astype(np.float32)
+    srng = np.random.RandomState(seed if sample_seed is None else sample_seed)
+    labels = srng.randint(0, num_classes, size=(num,)).astype(np.int32)
+    eps = srng.rand(num, *shape).astype(np.float32)
+    images = (1.0 - noise) * templates[labels] + noise * eps
+    return np.clip(images, 0.0, 1.0), labels

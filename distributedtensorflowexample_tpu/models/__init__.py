@@ -1,0 +1,25 @@
+from distributedtensorflowexample_tpu.models.softmax import SoftmaxRegression
+from distributedtensorflowexample_tpu.models.mnist_cnn import MnistCNN
+from distributedtensorflowexample_tpu.models.resnet import ResNet20, ResNetCIFAR
+
+import jax.numpy as jnp
+
+_REGISTRY = {
+    "softmax": lambda **kw: SoftmaxRegression(num_classes=10),
+    "mnist_cnn": lambda **kw: MnistCNN(num_classes=10,
+                                       dropout_rate=kw.get("dropout", 0.5),
+                                       dtype=kw.get("dtype", jnp.bfloat16)),
+    "resnet20": lambda **kw: ResNet20(num_classes=10,
+                                      dtype=kw.get("dtype", jnp.bfloat16)),
+}
+
+
+def build_model(name: str, **kw):
+    """Model registry keyed by the names the trainer CLIs use."""
+    try:
+        return _REGISTRY[name](**kw)
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}") from None
+
+
+__all__ = ["SoftmaxRegression", "MnistCNN", "ResNet20", "ResNetCIFAR", "build_model"]

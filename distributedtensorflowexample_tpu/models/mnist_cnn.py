@@ -1,0 +1,34 @@
+"""MNIST CNN (component C9, SURVEY.md §2) — the canonical deep-MNIST net.
+
+Reference behavior [RECONSTRUCTED from BASELINE.json configs 2-3]: two
+conv+maxpool stages, a 1024-wide FC layer with dropout, and a 10-way head.
+TPU notes: NHWC layout, bfloat16 compute with float32 params (MXU-friendly),
+dropout only when ``train=True`` so the eval graph stays deterministic.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)

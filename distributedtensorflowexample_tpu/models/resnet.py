@@ -1,0 +1,72 @@
+"""CIFAR-10 ResNet (component C9′, SURVEY.md §2).
+
+Reference behavior [RECONSTRUCTED from BASELINE.json configs 4-5]: ResNet-20
+— 3 stages × 3 basic residual blocks at widths 16/32/64, batch norm, global
+average pool, 10-way head (He et al. CIFAR variant).
+
+TPU notes: NHWC + bfloat16 compute keeps convs on the MXU; BN statistics are
+computed over the *sharded global* batch dim inside the jitted step, so under
+data parallelism XLA inserts the cross-replica reduction — giving sync-BN
+semantics deterministically (the reference's per-replica BN is a GPU-strategy
+artifact, not a capability we must preserve).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, strides=self.strides, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, name="conv2")(y)
+        y = norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, kernel_size=(1, 1),
+                            strides=self.strides, name="proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCIFAR(nn.Module):
+    """He-style CIFAR ResNet: depth = 6n+2 with n blocks per stage."""
+    blocks_per_stage: int = 3
+    widths: tuple[int, ...] = (16, 32, 64)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        for stage, width in enumerate(self.widths):
+            for block in range(self.blocks_per_stage):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BasicBlock(width, strides, self.dtype,
+                               name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet20(num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16) -> ResNetCIFAR:
+    return ResNetCIFAR(blocks_per_stage=3, num_classes=num_classes, dtype=dtype)

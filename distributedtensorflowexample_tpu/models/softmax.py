@@ -1,0 +1,21 @@
+"""MNIST softmax regression (component C8, SURVEY.md §2).
+
+Reference behavior [RECONSTRUCTED from BASELINE.json config 1]:
+``y = softmax(Wx + b)`` over flattened 28×28 images, cross-entropy loss.
+Here it is a pure flax module returning logits; loss lives in ops.losses so
+the same model composes with any parallelism mode.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SoftmaxRegression(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, name="logits")(x)

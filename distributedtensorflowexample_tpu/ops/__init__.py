@@ -1,0 +1,5 @@
+from distributedtensorflowexample_tpu.ops.losses import (
+    softmax_cross_entropy, accuracy,
+)
+
+__all__ = ["softmax_cross_entropy", "accuracy"]

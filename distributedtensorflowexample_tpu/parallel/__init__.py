@@ -1,0 +1,5 @@
+from distributedtensorflowexample_tpu.parallel.mesh import (
+    make_mesh, batch_sharding, replicated_sharding, DATA_AXIS,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "DATA_AXIS"]

@@ -1,0 +1,138 @@
+"""Async parameter-server emulation via local SGD (SURVEY.md §7 step 6,
+option b — config 2, BASELINE.json configs[1]).
+
+The reference's async mode: each worker pulls variables from the PS, steps
+on its own minibatch, and pushes updates with no inter-worker sync — stale
+gradients ARE the semantics (SURVEY.md §3b).  True asynchrony has no
+XLA-native analog (one program, lockstep devices), so we emulate the
+statistical behavior TPU-natively:
+
+* each of the mesh's devices hosts one *virtual worker* — a full parameter
+  copy, sharded along ``DATA_AXIS`` on a leading worker axis (a vmap over
+  the mesh: every device steps ITS worker's params on ITS batch shard,
+  zero cross-device traffic);
+* every ``period`` steps the copies are averaged (the mean over the worker
+  axis lowers to an all-reduce over ICI) — bounded staleness instead of
+  unbounded PS races, same "workers diverge then reconcile" dynamics,
+  fully deterministic and restartable.
+
+``period=1`` recovers exact sync SGD; large ``period`` approaches
+independent workers.  The branch is a ``lax.cond`` so the whole step stays
+one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributedtensorflowexample_tpu.ops.losses import (
+    accuracy, softmax_cross_entropy)
+from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+def make_worker_state(state: TrainState, num_workers: int, mesh) -> TrainState:
+    """Tile replicated state into per-worker copies sharded over the mesh.
+
+    Leading axis = virtual worker id; NamedSharding P(DATA_AXIS) puts one
+    worker's copy on each device.
+    """
+    wshard = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(DATA_AXIS))
+
+    def tile(x):
+        x = jnp.asarray(x)
+        tiled = jnp.broadcast_to(x[None], (num_workers,) + x.shape)
+        return jax.lax.with_sharding_constraint(tiled, wshard)
+
+    tile_tree = jax.jit(lambda t: jax.tree.map(tile, t), out_shardings=wshard)
+    return state.replace(params=tile_tree(state.params),
+                         opt_state=tile_tree(state.opt_state),
+                         batch_stats=tile_tree(state.batch_stats))
+
+
+def consolidate(state: TrainState) -> TrainState:
+    """Average the worker copies back into one replicated state (for eval,
+    checkpoint hand-off to sync mode, or end of training)."""
+
+    def avg(t):
+        return jax.tree.map(lambda x: jnp.mean(x.astype(jnp.float32), axis=0)
+                            .astype(x.dtype), t)
+
+    def first(t):
+        return jax.tree.map(lambda x: x[0], t)
+
+    return state.replace(params=jax.jit(avg)(state.params),
+                         # optimizer moments averaged too (momentum is linear)
+                         opt_state=jax.jit(avg)(state.opt_state),
+                         batch_stats=jax.jit(avg)(state.batch_stats)
+                         if state.batch_stats else state.batch_stats)
+
+
+def make_async_train_step(num_workers: int, period: int,
+                          label_smoothing: float = 0.0) -> Callable:
+    """Build the jitted local-SGD step over worker-tiled state.
+
+    Batch arrives as the usual global batch sharded on DATA_AXIS; it is
+    reshaped to [workers, per_worker_batch, ...] (device-local, no data
+    movement) and vmapped.
+    """
+    period = max(1, int(period))
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        has_bn = bool(state.batch_stats)
+
+        def per_worker(params, opt_state, stats, wbatch, rng):
+            def loss_fn(p):
+                variables = {"params": p}
+                if has_bn:
+                    variables["batch_stats"] = stats
+                    logits, updated = state.apply_fn(
+                        variables, wbatch["image"], train=True,
+                        rngs={"dropout": rng}, mutable=["batch_stats"])
+                    new_stats = updated["batch_stats"]
+                else:
+                    logits = state.apply_fn(variables, wbatch["image"],
+                                            train=True, rngs={"dropout": rng})
+                    new_stats = stats
+                loss = softmax_cross_entropy(logits, wbatch["label"],
+                                             label_smoothing)
+                return loss, (logits, new_stats)
+
+            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = state.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            metrics = {"loss": loss,
+                       "accuracy": accuracy(logits, wbatch["label"])}
+            return new_params, new_opt, new_stats, metrics
+
+        # [G, ...] -> [W, G/W, ...]; shards are device-local so this is free.
+        wbatch = jax.tree.map(
+            lambda x: x.reshape((num_workers, x.shape[0] // num_workers)
+                                + x.shape[1:]), batch)
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        worker_rngs = jax.random.split(step_rng, num_workers)
+        new_params, new_opt, new_stats, metrics = jax.vmap(per_worker)(
+            state.params, state.opt_state, state.batch_stats, wbatch,
+            worker_rngs)
+
+        new_step = state.step + 1
+
+        def average(tree):
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(
+                    jnp.mean(x.astype(jnp.float32), axis=0,
+                             keepdims=True).astype(x.dtype), x.shape), tree)
+
+        new_params = jax.lax.cond(new_step % period == 0,
+                                  average, lambda t: t, new_params)
+        new_state = state.replace(step=new_step, params=new_params,
+                                  opt_state=new_opt, batch_stats=new_stats)
+        metrics = jax.tree.map(lambda m: jnp.mean(m), metrics)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=0)

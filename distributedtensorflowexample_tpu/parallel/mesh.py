@@ -1,0 +1,47 @@
+"""Device mesh + sharding helpers — the SPMD core.
+
+This single abstraction replaces all four of the reference's distribution
+mechanisms (SURVEY.md §2 strategy inventory): ``replica_device_setter`` PS
+placement, ``SyncReplicasOptimizer`` aggregation, single-host NCCL
+MirroredStrategy, and multi-host collective all-reduce.  Parameters get a
+fully-replicated ``NamedSharding``; batches are sharded along ``DATA_AXIS``;
+XLA inserts the psum over ICI when the jitted step reduces across the batch.
+
+The mesh is 1-D today (the reference is data-parallel only) but axis naming
+keeps the door open for tensor/pipeline axes later.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
+    """A 1-D data-parallel mesh over the first ``num_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices and num_devices > 0:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim across the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — what 'mirrored variables' become on a mesh."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device_put a host batch onto the mesh, sharded along DATA_AXIS."""
+    return jax.device_put(batch, batch_sharding(mesh))

@@ -1,0 +1,133 @@
+"""Shared trainer runner — what every reference trainer.py script did,
+deduplicated (SURVEY.md §3 call stacks L5→L4→L3→L2 in one place).
+
+Flow: resolve cluster flags → (maybe) jax.distributed.initialize → build the
+mesh → data → model/optimizer/state (sharded at init) → hooks → loop →
+final eval.  Each entrypoint script just supplies flag defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedtensorflowexample_tpu import cluster
+from distributedtensorflowexample_tpu.config import RunConfig
+from distributedtensorflowexample_tpu.data import (
+    Batcher, DevicePrefetcher, load_cifar10, load_mnist)
+from distributedtensorflowexample_tpu.data.cifar10 import augment as cifar_augment
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.parallel import (
+    batch_sharding, make_mesh, replicated_sharding)
+from distributedtensorflowexample_tpu.parallel.async_ps import (
+    consolidate, make_async_train_step, make_worker_state)
+from distributedtensorflowexample_tpu.parallel.sync import (
+    evaluate, make_train_step)
+from distributedtensorflowexample_tpu.training.checkpoint import CheckpointManager
+from distributedtensorflowexample_tpu.training.hooks import (
+    CheckpointHook, EvalHook)
+from distributedtensorflowexample_tpu.training.loop import TrainLoop
+from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
+from distributedtensorflowexample_tpu.training.optimizers import build_optimizer
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+_SAMPLE_SHAPES = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
+
+
+def _load_dataset(cfg: RunConfig, name: str, split: str):
+    if name == "mnist":
+        return load_mnist(cfg.data_dir, split, seed=cfg.seed)
+    if name == "cifar10":
+        return load_cifar10(cfg.data_dir, split, seed=cfg.seed)
+    raise ValueError(f"unknown dataset {name!r}")
+
+
+def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
+                 augment: bool = False) -> dict:
+    """Train per config; returns a summary dict (used by tests and bench)."""
+    info = cluster.resolve(cfg)
+    if info.role == "ps":
+        print(cluster.PS_NOTICE, flush=True)
+        return {"role": "ps", "exited": True}
+    cluster.maybe_initialize_distributed(info)
+
+    mesh = make_mesh(cfg.num_devices)
+    num_replicas = mesh.size
+    global_batch = cfg.batch_size if cfg.global_batch else cfg.batch_size * num_replicas
+    if global_batch % num_replicas:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{num_replicas} replicas")
+
+    train_x, train_y = _load_dataset(cfg, dataset_name, "train")
+    test_x, test_y = _load_dataset(cfg, dataset_name, "test")
+    batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
+                      process_index=jax.process_index(),
+                      process_count=jax.process_count(),
+                      augment_fn=cifar_augment if augment else None)
+    data_shard = batch_sharding(mesh)
+    repl = replicated_sharding(mesh)
+    batches = DevicePrefetcher(batcher, sharding=data_shard)
+
+    model = build_model(model_name, dropout=cfg.dropout,
+                        dtype=jnp.dtype(cfg.dtype))
+    tx = build_optimizer(cfg)
+    sample_shape = (global_batch,) + _SAMPLE_SHAPES[dataset_name]
+    state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
+
+    if cfg.sync_mode not in ("sync", "async"):
+        raise ValueError(f"unknown sync_mode {cfg.sync_mode!r}")
+    is_async = cfg.sync_mode == "async"
+    if is_async:
+        # Local-SGD emulation of the reference's async-PS staleness: one
+        # virtual worker per device, averaged every --async_period steps.
+        state = make_worker_state(state, num_replicas, mesh)
+
+    is_chief = info.is_chief and jax.process_index() == 0
+    logger = MetricsLogger(cfg.log_dir, num_chips=num_replicas,
+                           is_chief=is_chief, log_every=cfg.log_every)
+    hooks = []
+    manager = None
+    if cfg.checkpoint_every > 0 or cfg.resume:
+        manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
+                                    max_to_keep=cfg.keep_checkpoints)
+        if cfg.resume and manager.latest_step() is not None:
+            state = manager.restore(state)
+            if is_chief:
+                print(f"resumed from checkpoint at step {int(state.step)}",
+                      flush=True)
+        if cfg.checkpoint_every > 0:
+            hooks.append(CheckpointHook(manager, cfg.checkpoint_every))
+
+    # Eval batch must divide across the mesh like the train batch does.
+    eval_batch = max(global_batch,
+                     (1000 // num_replicas) * num_replicas or num_replicas)
+    _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
+                                  batch_size=eval_batch, sharding=data_shard)
+    # Async state carries per-worker copies; eval on their average.
+    eval_fn = (lambda s: _evaluate(consolidate(s))) if is_async else _evaluate
+    if cfg.eval_every > 0:
+        hooks.append(EvalHook(eval_fn, cfg.eval_every, logger))
+
+    train_step = (make_async_train_step(num_replicas, cfg.async_period,
+                                        cfg.label_smoothing)
+                  if is_async else make_train_step(cfg.label_smoothing))
+    with mesh:
+        loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger)
+        state = loop.run(state)
+        final_acc = eval_fn(state)
+
+    if manager is not None and cfg.checkpoint_every == 0:
+        manager.save(int(state.step), state, force=True)
+        manager.wait()
+    logger.scalar(int(state.step), "final_accuracy", final_acc)
+    steps_per_sec = logger.last_steps_per_sec
+    logger.close()
+    return {"final_accuracy": final_acc,
+            "steps": int(state.step),
+            "steps_per_sec": steps_per_sec,
+            "steps_per_sec_per_chip": steps_per_sec / max(1, num_replicas),
+            "num_replicas": num_replicas,
+            "global_batch": global_batch}

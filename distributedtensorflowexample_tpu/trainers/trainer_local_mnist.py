@@ -1,0 +1,26 @@
+"""Config 1 — single-process local MNIST softmax (BASELINE.json configs[0]).
+
+Reference stack (SURVEY.md §3a): build softmax graph, ``sess.run(train_op,
+feed_dict=...)`` per minibatch, final accuracy eval.  Rebuild: one jitted
+SGD step on device-resident batches; runs unchanged on CPU or a single TPU
+chip (``--num_devices=1``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.trainers.common import run_training
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__,
+                      batch_size=100, train_steps=1000, learning_rate=0.5,
+                      num_devices=1, dataset="mnist")
+    return run_training(cfg, model_name="softmax", dataset_name="mnist")
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

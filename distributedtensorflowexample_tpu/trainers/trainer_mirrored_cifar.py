@@ -1,0 +1,29 @@
+"""Config 4 — single-host data-parallel CIFAR-10 ResNet-20
+(BASELINE.json configs[3]).
+
+Reference stack (SURVEY.md §3d): ``tf.distribute.MirroredStrategy`` — N GPU
+replicas, NCCL ring all-reduce of gradients.  Rebuild: one mesh over the
+host's TPU chips; the all-reduce is the XLA psum over ICI inside the jitted
+step, overlapped with backprop by the compiler.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.trainers.common import run_training
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__,
+                      batch_size=128, train_steps=5000, learning_rate=0.1,
+                      momentum=0.9, weight_decay=1e-4, lr_schedule="step",
+                      warmup_steps=200, dataset="cifar10")
+    return run_training(cfg, model_name="resnet20", dataset_name="cifar10",
+                        augment=True)
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

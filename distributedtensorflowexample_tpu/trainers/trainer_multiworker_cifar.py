@@ -1,0 +1,33 @@
+"""Config 5 — multi-host data-parallel CIFAR-10 ResNet-20
+(BASELINE.json configs[4]).
+
+Reference stack (SURVEY.md §3d): ``MultiWorkerMirroredStrategy`` with
+``TF_CONFIG`` cluster resolution and collective all-reduce across 2 hosts.
+Rebuild: same SPMD program on every process — ``TF_CONFIG`` (or
+``--worker_hosts``/``--coordinator_address``) resolves to
+``jax.distributed.initialize``; the mesh spans all hosts' chips and the
+gradient psum rides ICI within a slice / DCN across hosts.  Chief-only
+logging/checkpointing == process 0 (the reference's chief semantics).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.trainers.common import run_training
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__,
+                      batch_size=128, train_steps=5000, learning_rate=0.1,
+                      momentum=0.9, weight_decay=1e-4, lr_schedule="step",
+                      warmup_steps=200, dataset="cifar10", job_name="worker")
+    return run_training(cfg, model_name="resnet20", dataset_name="cifar10",
+                        augment=True)
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    if not summary.get("exited"):
+        print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

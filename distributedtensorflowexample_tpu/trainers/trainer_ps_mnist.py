@@ -1,0 +1,34 @@
+"""Config 2 — async parameter-server MNIST CNN (BASELINE.json configs[1]).
+
+Reference stack (SURVEY.md §3b): ``ClusterSpec({"ps": [...], "worker":
+[...]})``, ``tf.train.Server``, ``replica_device_setter`` pinning variables
+to PS tasks, each worker stepping asynchronously against shared variables
+(stale gradients by design).
+
+Rebuild (SURVEY.md §7 step 6): there are no PS processes — ``--job_name=ps``
+exits with a notice; the full ClusterSpec CLI is accepted as compatibility
+aliases.  By default the workload runs on the deterministic sync-SPMD path
+(documented semantic change).  ``--sync_mode=async`` opts into local-SGD
+emulation of async staleness: per-replica parameter copies step
+independently and average every ``--async_period`` steps.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.trainers.common import run_training
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__,
+                      batch_size=64, train_steps=2000, learning_rate=0.05,
+                      momentum=0.9, dataset="mnist", sync_mode="sync")
+    return run_training(cfg, model_name="mnist_cnn", dataset_name="mnist")
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    if not summary.get("exited"):
+        print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

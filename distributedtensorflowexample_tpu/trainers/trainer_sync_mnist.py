@@ -1,0 +1,27 @@
+"""Config 3 — sync-SGD MNIST CNN (BASELINE.json configs[2]).
+
+Reference stack (SURVEY.md §3c): ``tf.train.SyncReplicasOptimizer`` with
+PS-side gradient accumulators + token-queue barrier over 2 workers.
+Rebuild: the barrier IS the XLA psum inside one jitted step over the mesh —
+``replicas_to_aggregate`` == mesh size always (exact sync, no stragglers to
+tolerate because the step is a single SPMD program).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.trainers.common import run_training
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__,
+                      batch_size=64, train_steps=2000, learning_rate=0.05,
+                      momentum=0.9, dataset="mnist", sync_mode="sync")
+    return run_training(cfg, model_name="mnist_cnn", dataset_name="mnist")
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

@@ -1,0 +1,5 @@
+from distributedtensorflowexample_tpu.training.state import TrainState
+from distributedtensorflowexample_tpu.training.optimizers import build_optimizer
+from distributedtensorflowexample_tpu.training.loop import TrainLoop
+
+__all__ = ["TrainState", "build_optimizer", "TrainLoop"]

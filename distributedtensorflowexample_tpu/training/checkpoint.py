@@ -1,0 +1,65 @@
+"""Checkpoint/resume via Orbax (SURVEY.md §5: the mandated mapping from
+``MonitoredTrainingSession`` checkpoint hooks / ``Saver``).
+
+Semantics preserved from the reference: periodic saves, keep-N rotation,
+auto-restore-from-latest on startup, chief-only effective writes (Orbax is
+multi-host aware — every process must call save, primary writes).  Gained:
+async saves (training does not stall on serialization).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+def _saveable(state: TrainState) -> dict[str, Any]:
+    # tx/apply_fn are static code, not state — exclude from serialization.
+    return {"step": state.step, "params": state.params,
+            "opt_state": state.opt_state, "batch_stats": state.batch_stats,
+            "rng": state.rng}
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save))
+
+    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+        step = int(step)
+        if step in self._mgr.all_steps():
+            return False  # periodic save already covered this step
+        return self._mgr.save(step,
+                              args=ocp.args.StandardSave(_saveable(state)),
+                              force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state: TrainState, step: int | None = None) -> TrainState:
+        """Restore into the structure (and shardings) of ``state``."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return state
+        template = jax.tree.map(lambda x: x, _saveable(state))
+        restored = self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(template))
+        return state.replace(**restored)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,61 @@
+"""Training hooks — the MonitoredTrainingSession hook surface, JAX-native.
+
+The reference attached ``StopAtStepHook`` / checkpoint / summary hooks to
+``MonitoredTrainingSession`` (BASELINE.json north star names the API).  Here
+a hook sees the loop at well-defined points; stopping is a return value so
+the loop stays a plain Python for-loop around one jitted call.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+class Hook:
+    def begin(self, loop) -> None: ...
+    def after_step(self, step: int, state: "TrainState", metrics) -> bool:
+        """Return True to request a stop (StopAtStepHook semantics)."""
+        return False
+    def end(self, state: "TrainState") -> None: ...
+
+
+class StopAtStepHook(Hook):
+    def __init__(self, last_step: int):
+        self._last_step = last_step
+
+    def after_step(self, step, state, metrics) -> bool:
+        return step >= self._last_step
+
+
+class CheckpointHook(Hook):
+    """Periodic + final checkpoint via the Orbax-backed manager."""
+
+    def __init__(self, manager, every: int):
+        self._manager = manager
+        self._every = every
+
+    def after_step(self, step, state, metrics) -> bool:
+        if self._every and step % self._every == 0:
+            self._manager.save(step, state)
+        return False
+
+    def end(self, state) -> None:
+        self._manager.save(int(state.step), state, force=True)
+        self._manager.wait()
+
+
+class EvalHook(Hook):
+    """Periodic exact-accuracy eval on a held-out split."""
+
+    def __init__(self, eval_fn, every: int, logger):
+        self._eval_fn = eval_fn
+        self._every = every
+        self._logger = logger
+
+    def after_step(self, step, state, metrics) -> bool:
+        if self._every and step % self._every == 0:
+            self._logger.scalar(step, "eval_accuracy", self._eval_fn(state))
+        return False

@@ -1,0 +1,50 @@
+"""The training loop (component C12, SURVEY.md §2).
+
+Replaces ``MonitoredTrainingSession``: a plain Python loop around ONE jitted
+step call, with hooks for stop/checkpoint/eval/logging.  Per-step host work
+is a dict lookup and an iterator next — metrics stay on device until the log
+boundary, batches are prefetched (``DevicePrefetcher``), so the device never
+waits on the host at MNIST-scale step times.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import jax
+
+from distributedtensorflowexample_tpu.training.hooks import Hook
+from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+class TrainLoop:
+    def __init__(self, train_step, batches: Iterator, num_steps: int,
+                 hooks: Iterable[Hook] = (), logger: MetricsLogger | None = None):
+        self._train_step = train_step
+        self._batches = batches
+        self._num_steps = num_steps
+        self._hooks = list(hooks)
+        self._logger = logger or MetricsLogger()
+
+    def run(self, state: TrainState) -> TrainState:
+        start = int(state.step)
+        for h in self._hooks:
+            h.begin(self)
+        self._logger.start(start)
+        metrics = None
+        for step in range(start + 1, self._num_steps + 1):
+            state, metrics = self._train_step(state, next(self._batches))
+            self._logger.maybe_log(step, metrics)
+            # Every hook sees every step (no short-circuit) — a stop request
+            # must not mask another hook's work at the same step.
+            stops = [h.after_step(step, state, metrics) for h in self._hooks]
+            if any(stops):
+                break
+        # Drain outstanding device work so end-hooks (checkpoint) see final
+        # values and wall-clock accounting is honest.
+        if metrics is not None:
+            jax.block_until_ready(metrics)
+        for h in self._hooks:
+            h.end(state)
+        return state

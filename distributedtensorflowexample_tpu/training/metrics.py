@@ -1,0 +1,69 @@
+"""Step metrics + throughput accounting.
+
+steps/sec/chip is THE headline metric (BASELINE.json "metric"), so the loop
+owns its measurement: wall time between flushes, device arrays fetched only
+at log boundaries (never per step — that would serialize host and device),
+scalars mirrored to stdout (the reference's UX) and a JSONL scalar log (the
+``tf.summary`` replacement, greppable and TensorBoard-convertible).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str = "", num_chips: int = 1,
+                 is_chief: bool = True, log_every: int = 100):
+        self._num_chips = max(1, num_chips)
+        self._is_chief = is_chief
+        self._log_every = max(1, log_every)
+        self._last_time = None
+        self._last_step = 0
+        self._file = None
+        if log_dir and is_chief:
+            os.makedirs(log_dir, exist_ok=True)
+            self._file = open(os.path.join(log_dir, "scalars.jsonl"), "a",
+                              buffering=1)
+        self.last_steps_per_sec = 0.0
+
+    def start(self, step: int):
+        self._last_step = step
+        self._last_time = time.perf_counter()
+
+    def maybe_log(self, step: int, metrics) -> None:
+        if step % self._log_every:
+            return
+        # Block on the metric values only here, at the log boundary.
+        fetched = {k: float(v) for k, v in
+                   jax.device_get(metrics).items()}
+        now = time.perf_counter()
+        if self._last_time is not None and step > self._last_step:
+            dt = now - self._last_time
+            sps = (step - self._last_step) / dt
+            self.last_steps_per_sec = sps
+            fetched["steps_per_sec"] = round(sps, 2)
+            fetched["steps_per_sec_per_chip"] = round(sps / self._num_chips, 2)
+        self._last_time = now
+        self._last_step = step
+        if self._is_chief:
+            parts = " ".join(f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                             for k, v in fetched.items())
+            print(f"step {step}: {parts}", flush=True)
+            if self._file:
+                self._file.write(json.dumps({"step": step, **fetched}) + "\n")
+
+    def scalar(self, step: int, name: str, value: float) -> None:
+        if self._is_chief:
+            print(f"step {step}: {name}={value:.4f}", flush=True)
+            if self._file:
+                self._file.write(json.dumps({"step": step, name: value}) + "\n")
+
+    def close(self):
+        if self._file:
+            self._file.close()
+            self._file = None

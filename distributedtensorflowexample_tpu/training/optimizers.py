@@ -1,0 +1,45 @@
+"""Optimizer construction (reference: GradientDescentOptimizer /
+SyncReplicasOptimizer wrapping — SURVEY.md §3b/§3c).
+
+Sync gradient aggregation needs no optimizer wrapper here: by the time
+updates are applied the gradients are already the global-batch mean (XLA
+psum inside the jitted step), which is exactly what SyncReplicasOptimizer's
+PS-side accumulator barrier produced.  So this module only builds the base
+transformation + LR schedule.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributedtensorflowexample_tpu.config import RunConfig
+
+
+def build_schedule(cfg: RunConfig) -> optax.Schedule:
+    base = cfg.learning_rate
+    if cfg.lr_schedule == "constant":
+        sched = optax.constant_schedule(base)
+    elif cfg.lr_schedule == "cosine":
+        decay_steps = max(1, cfg.train_steps - cfg.warmup_steps)
+        sched = optax.cosine_decay_schedule(base, decay_steps)
+    elif cfg.lr_schedule == "step":
+        # He-style CIFAR schedule: /10 at 50% and 75% of training.
+        sched = optax.piecewise_constant_schedule(
+            base, {cfg.train_steps // 2: 0.1, (cfg.train_steps * 3) // 4: 0.1})
+    else:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, base, cfg.warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def build_optimizer(cfg: RunConfig) -> optax.GradientTransformation:
+    sched = build_schedule(cfg)
+    if cfg.momentum > 0.0:
+        tx = optax.sgd(sched, momentum=cfg.momentum, nesterov=False)
+    else:
+        tx = optax.sgd(sched)
+    if cfg.weight_decay > 0.0:
+        tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    return tx

@@ -1,0 +1,64 @@
+"""Training state pytree.
+
+One immutable pytree carries everything the jitted step mutates — the JAX
+analog of the reference's mutable graph variables + global_step owned by the
+``MonitoredTrainingSession``.  Keeping it a single pytree lets the step
+donate it (in-place HBM update, no realloc) and lets Orbax checkpoint it
+wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                     # scalar int32 — the global_step
+    params: Any
+    opt_state: Any
+    batch_stats: Any                      # BN running stats ({} if none)
+    rng: jax.Array                        # base PRNG key; fold_in(step) per step
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, model, tx: optax.GradientTransformation,
+               sample_input: jnp.ndarray, seed: int = 0) -> "TrainState":
+        rng = jax.random.PRNGKey(seed)
+        init_rng, state_rng = jax.random.split(rng)
+        variables = model.init({"params": init_rng, "dropout": init_rng},
+                               sample_input, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), batch_stats=batch_stats,
+                   rng=state_rng, tx=tx, apply_fn=model.apply)
+
+    @classmethod
+    def create_sharded(cls, model, tx: optax.GradientTransformation,
+                       sample_shape: tuple[int, ...], seed: int,
+                       sharding) -> "TrainState":
+        """Init directly into a (replicated) NamedSharding under jit.
+
+        Initializing under jit with ``out_shardings`` is the multi-host-safe
+        path: every process traces the same program, XLA materializes the
+        state already laid out on the mesh — no host-side init + transfer.
+        """
+        def init(rng):
+            init_rng, state_rng = jax.random.split(rng)
+            variables = model.init({"params": init_rng, "dropout": init_rng},
+                                   jnp.zeros(sample_shape, jnp.float32),
+                                   train=False)
+            params = variables["params"]
+            return cls(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=tx.init(params),
+                       batch_stats=variables.get("batch_stats", {}),
+                       rng=state_rng, tx=tx, apply_fn=model.apply)
+
+        return jax.jit(init, out_shardings=sharding)(jax.random.PRNGKey(seed))

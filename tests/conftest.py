@@ -1,0 +1,21 @@
+"""Test environment: 8 virtual CPU devices.
+
+Replaces the reference's "4 processes on localhost with distinct ports"
+trick (SURVEY.md §4): the real mesh/NamedSharding/psum code path runs
+unchanged on fake CPU devices — no TPU needed for distribution tests.
+
+Note: this image's sitecustomize force-registers the axon TPU platform and
+overrides JAX_PLATFORMS from the environment, so the env-var route does not
+work here — the config must be updated in-process before first backend use.
+"""
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture()
+def tmp_log_dir(tmp_path):
+    return str(tmp_path / "logs")

@@ -1,0 +1,93 @@
+"""Async-PS emulation (local SGD) — config 2 semantics (SURVEY.md §7 step 6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.parallel import (
+    batch_sharding, make_mesh, replicated_sharding)
+from distributedtensorflowexample_tpu.parallel.async_ps import (
+    consolidate, make_async_train_step, make_worker_state)
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+def _tiled_state(mesh, lr=0.2, seed=0):
+    model = build_model("softmax")
+    state = TrainState.create_sharded(model, optax.sgd(lr), (8, 28, 28, 1),
+                                      seed, replicated_sharding(mesh))
+    return make_worker_state(state, mesh.size, mesh)
+
+
+def _batch(mesh, n, seed=0, sample_seed=None):
+    x, y = make_synthetic(n, (28, 28, 1), 10, seed=seed,
+                          sample_seed=sample_seed)
+    return jax.device_put({"image": x, "label": y}, batch_sharding(mesh))
+
+
+def test_worker_state_tiled_and_sharded():
+    mesh = make_mesh()
+    state = _tiled_state(mesh)
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.shape[0] == 8
+    assert not leaf.sharding.is_fully_replicated
+    # All workers start from identical copies.
+    host = jax.device_get(leaf)
+    for w in range(1, 8):
+        np.testing.assert_array_equal(host[0], host[w])
+
+
+def test_workers_diverge_then_average():
+    mesh = make_mesh()
+    state = _tiled_state(mesh)
+    step = make_async_train_step(mesh.size, period=4)
+    for i in range(3):  # steps 1..3: no averaging yet
+        state, _ = step(state, _batch(mesh, 64, sample_seed=10 + i))
+    leaf = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.array_equal(leaf[0], leaf[1])  # diverged (different shards)
+    state, _ = step(state, _batch(mesh, 64, sample_seed=99))  # step 4: average
+    leaf = jax.device_get(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(leaf[0], leaf[1], rtol=1e-6, atol=1e-7)
+
+
+def test_period_one_matches_sync_semantics():
+    """period=1 averages every step — gradient-mean == sync SGD up to fp."""
+    mesh = make_mesh()
+    state = _tiled_state(mesh, lr=0.1)
+    step = make_async_train_step(mesh.size, period=1)
+    state, metrics = step(state, _batch(mesh, 64))
+    assert np.isfinite(float(metrics["loss"]))
+    leaf = jax.device_get(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(leaf[0], leaf[7], rtol=1e-6, atol=1e-7)
+
+
+def test_async_converges_and_consolidates():
+    mesh = make_mesh()
+    state = _tiled_state(mesh, lr=0.3)
+    step = make_async_train_step(mesh.size, period=4)
+    x, y = make_synthetic(64 * 20, (28, 28, 1), 10, seed=0)
+    losses = []
+    for i in range(20):
+        sl = slice(i * 64, (i + 1) * 64)
+        batch = jax.device_put({"image": x[sl], "label": y[sl]},
+                               batch_sharding(mesh))
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+    merged = consolidate(state)
+    leaf = jax.tree.leaves(merged.params)[0]
+    assert leaf.ndim == jax.tree.leaves(state.params)[0].ndim - 1
+
+
+def test_async_trainer_end_to_end(tmp_path):
+    from distributedtensorflowexample_tpu.trainers import trainer_ps_mnist
+    summary = trainer_ps_mnist.main(
+        ["--sync_mode", "async", "--async_period", "4",
+         "--train_steps", "30", "--batch_size", "8",
+         "--log_dir", str(tmp_path), "--data_dir", "/nonexistent",
+         "--resume", "false", "--log_every", "10",
+         "--learning_rate", "0.02"])
+    assert summary["steps"] == 30
+    assert np.isfinite(summary["final_accuracy"])

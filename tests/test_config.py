@@ -1,0 +1,63 @@
+"""CLI flag surface: reference-compatible names parse (SURVEY.md C6)."""
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu import cluster
+
+
+def test_defaults():
+    cfg = parse_flags([])
+    assert cfg.batch_size == 100
+    assert cfg.train_steps == 1000
+    assert cfg.job_name == ""
+
+
+def test_reference_cluster_flags_parse():
+    cfg = parse_flags([
+        "--job_name", "worker", "--task_index", "1",
+        "--ps_hosts", "h1:2222,h2:2222",
+        "--worker_hosts", "h3:2222,h4:2222",
+        "--batch_size", "64", "--train_steps", "500",
+        "--learning_rate", "0.01", "--data_dir", "/tmp/d",
+        "--log_dir", "/tmp/l",
+    ])
+    assert cfg.job_name == "worker"
+    assert cfg.task_index == 1
+    assert cfg.ps_host_list == ["h1:2222", "h2:2222"]
+    assert cfg.worker_host_list == ["h3:2222", "h4:2222"]
+
+
+def test_overrides_win_over_defaults():
+    cfg = parse_flags([], batch_size=7)
+    assert cfg.batch_size == 7
+    cfg = parse_flags(["--batch_size", "9"], batch_size=7)
+    assert cfg.batch_size == 9
+
+
+def test_ps_role_resolution():
+    cfg = parse_flags(["--job_name", "ps", "--task_index", "0",
+                       "--ps_hosts", "h1:2222", "--worker_hosts", "h2:2222"])
+    info = cluster.resolve(cfg)
+    assert info.role == "ps"
+    assert not info.is_chief
+
+
+def test_worker_hosts_resolution():
+    cfg = parse_flags(["--job_name", "worker", "--task_index", "1",
+                       "--worker_hosts", "h1:2222,h2:2222"])
+    info = cluster.resolve(cfg)
+    assert info.num_processes == 2
+    assert info.process_id == 1
+    assert not info.is_chief
+    assert info.coordinator_address == "h1:2222"
+
+
+def test_tf_config_resolution(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        '{"cluster": {"worker": ["a:1", "b:2"]}, '
+        '"task": {"type": "worker", "index": 1}}')
+    cfg = parse_flags([])
+    info = cluster.resolve(cfg)
+    assert info.num_processes == 2
+    assert info.process_id == 1
+    assert info.coordinator_address == "a:1"

@@ -1,0 +1,66 @@
+"""Data pipelines: loaders, determinism, per-process sharding (SURVEY.md C10/C11)."""
+
+import numpy as np
+
+from distributedtensorflowexample_tpu.data import (
+    Batcher, load_cifar10, load_mnist)
+from distributedtensorflowexample_tpu.data.cifar10 import augment
+
+
+def test_mnist_shapes_and_range(tmp_path):
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256)
+    assert x.shape == (256, 28, 28, 1)
+    assert x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    assert y.shape == (256,) and y.dtype == np.int32
+    assert set(np.unique(y)) <= set(range(10))
+
+
+def test_mnist_deterministic(tmp_path):
+    x1, y1 = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    x2, y2 = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+
+
+def test_mnist_train_test_differ(tmp_path):
+    x1, _ = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    x2, _ = load_mnist(str(tmp_path), "test", synthetic_size=64)
+    assert not np.array_equal(x1, x2)
+
+
+def test_cifar_shapes(tmp_path):
+    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=128)
+    assert x.shape == (128, 32, 32, 3)
+    assert y.shape == (128,)
+
+
+def test_cifar_augment_shapes():
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 32, 32, 3).astype(np.float32)
+    out = augment(x, rng)
+    assert out.shape == x.shape
+    assert not np.array_equal(out, x)
+
+
+def test_batcher_epoch_and_shapes():
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    y = np.arange(100, dtype=np.int32)
+    b = Batcher(x, y, batch_size=32, seed=0)
+    batch = next(b)
+    assert batch["image"].shape == (32, 1)
+    assert batch["label"].shape == (32,)
+
+
+def test_batcher_process_sharding_disjoint_and_covering():
+    """Two processes drawing the same seed must split every global batch
+    disjointly — the reference's per-worker dataset sharding."""
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    y = np.arange(64, dtype=np.int32)
+    b0 = Batcher(x, y, batch_size=16, seed=3, process_index=0, process_count=2)
+    b1 = Batcher(x, y, batch_size=16, seed=3, process_index=1, process_count=2)
+    assert b0.local_batch_size == 8
+    for _ in range(4):
+        s0, s1 = next(b0)["label"], next(b1)["label"]
+        assert len(set(s0) & set(s1)) == 0
+        assert len(set(s0) | set(s1)) == 16

@@ -1,0 +1,54 @@
+"""Model output shapes + param counts (SURVEY.md C8/C9/C9')."""
+
+import jax
+import jax.numpy as jnp
+
+from distributedtensorflowexample_tpu.models import (
+    MnistCNN, ResNet20, SoftmaxRegression, build_model)
+
+
+def _init_and_apply(model, shape, train=False):
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros(shape, jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, x, train=train)
+    if train and "batch_stats" in variables:
+        out, _ = model.apply(variables, x, train=True,
+                             rngs={"dropout": rng}, mutable=["batch_stats"])
+        return variables, out
+    out = model.apply(variables, x, train=train, rngs={"dropout": rng})
+    return variables, out
+
+
+def test_softmax_shapes():
+    _, out = _init_and_apply(SoftmaxRegression(), (4, 28, 28, 1))
+    assert out.shape == (4, 10)
+
+
+def test_softmax_param_count():
+    variables, _ = _init_and_apply(SoftmaxRegression(), (1, 28, 28, 1))
+    n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    assert n == 784 * 10 + 10
+
+
+def test_mnist_cnn_shapes_and_dtype():
+    _, out = _init_and_apply(MnistCNN(), (4, 28, 28, 1), train=True)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32  # logits upcast for a stable loss
+
+
+def test_resnet20_shapes():
+    _, out = _init_and_apply(ResNet20(), (2, 32, 32, 3))
+    assert out.shape == (2, 10)
+
+
+def test_resnet20_has_bn_stats_and_plausible_size():
+    variables, _ = _init_and_apply(ResNet20(), (1, 32, 32, 3))
+    assert "batch_stats" in variables
+    n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    assert 0.25e6 < n < 0.31e6  # ResNet-20 is ~0.27M params
+
+
+def test_registry():
+    assert isinstance(build_model("softmax"), SoftmaxRegression)
+    assert isinstance(build_model("mnist_cnn"), MnistCNN)
+    assert build_model("mnist_cnn", dropout=0.3).dropout_rate == 0.3

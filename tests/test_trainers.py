@@ -1,0 +1,46 @@
+"""End-to-end trainer runs (tiny) — the reference's run-to-verify checks
+as real tests (SURVEY.md §4 convergence smoke tests)."""
+
+import numpy as np
+import pytest
+
+from distributedtensorflowexample_tpu.trainers import (
+    trainer_local_mnist, trainer_mirrored_cifar, trainer_ps_mnist,
+    trainer_sync_mnist)
+
+
+def _common_flags(tmp_log_dir, extra=()):
+    return ["--log_dir", tmp_log_dir, "--data_dir", "/nonexistent",
+            "--resume", "false", "--log_every", "20", *extra]
+
+
+def test_local_softmax_converges(tmp_log_dir):
+    summary = trainer_local_mnist.main(_common_flags(
+        tmp_log_dir, ["--train_steps", "150", "--batch_size", "64"]))
+    assert summary["final_accuracy"] > 0.9
+    assert summary["steps"] == 150
+
+
+def test_sync_cnn_smoke(tmp_log_dir):
+    summary = trainer_sync_mnist.main(_common_flags(
+        tmp_log_dir, ["--train_steps", "30", "--batch_size", "16",
+                      "--learning_rate", "0.02"]))
+    assert summary["steps"] == 30
+    assert summary["num_replicas"] == 8
+    assert np.isfinite(summary["final_accuracy"])
+
+
+def test_ps_role_exits_with_notice(tmp_log_dir, capsys):
+    summary = trainer_ps_mnist.main(
+        ["--job_name", "ps", "--task_index", "0",
+         "--ps_hosts", "h:1", "--worker_hosts", "h:2"])
+    assert summary["exited"]
+    assert "exit" in capsys.readouterr().out.lower()
+
+
+def test_mirrored_resnet_smoke(tmp_log_dir):
+    summary = trainer_mirrored_cifar.main(_common_flags(
+        tmp_log_dir, ["--train_steps", "10", "--batch_size", "8",
+                      "--warmup_steps", "2"]))
+    assert summary["steps"] == 10
+    assert np.isfinite(summary["final_accuracy"])
