@@ -1,0 +1,83 @@
+"""Benchmark harness — emits ONE JSON line with the headline metric.
+
+Headline (BASELINE.json "metric"): MNIST steps/sec/chip, sync-SGD.
+The reference published no numbers (BASELINE.json "published": {}), so
+``vs_baseline`` is computed against this repo's own recorded baseline in
+``BASELINE_SELF.json`` when present (written by earlier rounds), else 1.0.
+
+Runs the real trainer stack (jitted sync step, device prefetch) on the
+default platform — the driver invokes this on a real TPU chip.  Exits
+cleanly (no hard kill needed): small fixed step counts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+WARMUP_STEPS = 20
+MEASURE_STEPS = 200
+BATCH_PER_CHIP = 256
+
+
+def main() -> None:
+    import optax
+
+    from distributedtensorflowexample_tpu.data import Batcher, DevicePrefetcher
+    from distributedtensorflowexample_tpu.data.mnist import load_mnist
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel import (
+        batch_sharding, make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.sync import make_train_step
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    mesh = make_mesh()
+    num_chips = mesh.size
+    global_batch = BATCH_PER_CHIP * num_chips
+
+    train_x, train_y = load_mnist("/tmp/data", "train")
+    batcher = Batcher(train_x, train_y, global_batch, seed=0)
+    batches = DevicePrefetcher(batcher, sharding=batch_sharding(mesh), depth=2)
+
+    model = build_model("mnist_cnn", dropout=0.5)
+    state = TrainState.create_sharded(
+        model, optax.sgd(0.05, momentum=0.9),
+        (global_batch, 28, 28, 1), 0, replicated_sharding(mesh))
+    step = make_train_step()
+
+    with mesh:
+        for _ in range(WARMUP_STEPS):
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics)
+
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics)
+        dt = time.perf_counter() - t0
+
+    steps_per_sec = MEASURE_STEPS / dt
+    per_chip = steps_per_sec / num_chips
+
+    baseline = None
+    if os.path.exists("BASELINE_SELF.json"):
+        try:
+            with open("BASELINE_SELF.json") as f:
+                baseline = json.load(f).get("mnist_cnn_steps_per_sec_per_chip")
+        except (json.JSONDecodeError, OSError):
+            baseline = None
+    vs_baseline = round(per_chip / baseline, 4) if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "mnist_cnn_sync_steps_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "steps/sec/chip",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

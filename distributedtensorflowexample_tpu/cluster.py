@@ -46,13 +46,26 @@ def _from_tf_config() -> ClusterInfo | None:
         return None
     try:
         tf_config = json.loads(raw)
-        workers = tf_config["cluster"]["worker"]
+        clus = tf_config["cluster"]
         task = tf_config.get("task", {})
+        task_type = str(task.get("type", "worker"))
         idx = int(task.get("index", 0))
     except (json.JSONDecodeError, KeyError, TypeError, ValueError):
         return None
-    return ClusterInfo(num_processes=len(workers), process_id=idx,
-                       coordinator_address=workers[0], is_chief=(idx == 0))
+    if task_type == "ps":
+        return ClusterInfo(role="ps", is_chief=False)
+    # TF task ordering: an optional single-entry "chief" job precedes the
+    # "worker" job; both participate in training.  (An "evaluator" never
+    # joins the training cluster — treat like ps: nothing to serve here.)
+    if task_type == "evaluator":
+        return ClusterInfo(role="ps", is_chief=False)
+    chief = list(clus.get("chief", []))
+    workers = chief + list(clus.get("worker", []))
+    if not workers:
+        return None
+    pid = idx if task_type == "chief" else len(chief) + idx
+    return ClusterInfo(num_processes=len(workers), process_id=pid,
+                       coordinator_address=workers[0], is_chief=(pid == 0))
 
 
 def resolve(cfg: RunConfig) -> ClusterInfo:

@@ -33,6 +33,10 @@ class Batcher:
         if batch_size % process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by {process_count} processes")
+        if len(images) < batch_size:
+            raise ValueError(
+                f"dataset of {len(images)} examples is smaller than the "
+                f"global batch {batch_size}; shapes downstream are static")
         self._images = images
         self._labels = labels
         self._global_batch = batch_size

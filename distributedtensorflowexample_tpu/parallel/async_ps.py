@@ -62,9 +62,6 @@ def consolidate(state: TrainState) -> TrainState:
         return jax.tree.map(lambda x: jnp.mean(x.astype(jnp.float32), axis=0)
                             .astype(x.dtype), t)
 
-    def first(t):
-        return jax.tree.map(lambda x: x[0], t)
-
     return state.replace(params=jax.jit(avg)(state.params),
                          # optimizer moments averaged too (momentum is linear)
                          opt_state=jax.jit(avg)(state.opt_state),

@@ -23,9 +23,13 @@ def build_schedule(cfg: RunConfig) -> optax.Schedule:
         decay_steps = max(1, cfg.train_steps - cfg.warmup_steps)
         sched = optax.cosine_decay_schedule(base, decay_steps)
     elif cfg.lr_schedule == "step":
-        # He-style CIFAR schedule: /10 at 50% and 75% of training.
-        sched = optax.piecewise_constant_schedule(
-            base, {cfg.train_steps // 2: 0.1, (cfg.train_steps * 3) // 4: 0.1})
+        # He-style CIFAR schedule: /10 at 50% and 75% of training.  When
+        # warmup is joined in front, this schedule is evaluated at
+        # (step - warmup_steps), so express boundaries in that frame to keep
+        # the drops at the advertised global steps.
+        half = max(1, cfg.train_steps // 2 - cfg.warmup_steps)
+        three_q = max(2, (cfg.train_steps * 3) // 4 - cfg.warmup_steps)
+        sched = optax.piecewise_constant_schedule(base, {half: 0.1, three_q: 0.1})
     else:
         raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
     if cfg.warmup_steps > 0:

@@ -61,3 +61,26 @@ def test_tf_config_resolution(monkeypatch):
     assert info.num_processes == 2
     assert info.process_id == 1
     assert info.coordinator_address == "a:1"
+
+
+def test_tf_config_ps_task_routes_to_ps_role(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        '{"cluster": {"ps": ["p:1"], "worker": ["a:1"]}, '
+        '"task": {"type": "ps", "index": 0}}')
+    info = cluster.resolve(parse_flags([]))
+    assert info.role == "ps"
+    assert not info.is_chief
+
+
+def test_tf_config_chief_job(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        '{"cluster": {"chief": ["c:1"], "worker": ["a:1", "b:2"]}, '
+        '"task": {"type": "worker", "index": 1}}')
+    info = cluster.resolve(parse_flags([]))
+    # chief occupies process 0; worker 1 is process 2 of 3.
+    assert info.num_processes == 3
+    assert info.process_id == 2
+    assert info.coordinator_address == "c:1"
+    assert not info.is_chief
