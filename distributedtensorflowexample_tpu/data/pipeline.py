@@ -17,6 +17,41 @@ import jax
 import numpy as np
 
 
+def put_local_batch(batch, sharding):
+    """Device-put a batch whose arrays are this PROCESS'S LOCAL SHARD of the
+    global batch (what :class:`Batcher` yields under process sharding)."""
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch)
+    return jax.device_put(batch, sharding)
+
+
+def put_global_batch(batch, sharding):
+    """Device-put a batch whose arrays are the FULL GLOBAL batch, identical
+    on every process (e.g. an eval split every host loaded).
+
+    Each process keeps only the contiguous row-range its devices own —
+    mesh device order is jax.devices(), which groups devices by process, so
+    shard p of the leading axis lives on process p's devices.
+    """
+    pc = jax.process_count()
+    if pc == 1:
+        return jax.device_put(batch, sharding)
+    pi = jax.process_index()
+
+    def local_rows(x):
+        if x.shape[0] % pc:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by {pc} processes")
+        per = x.shape[0] // pc
+        return x[pi * per:(pi + 1) * per]
+
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, local_rows(x)), batch)
+
+
 class Batcher:
     """Infinite shuffled minibatch stream over an in-memory array pair.
 
@@ -96,15 +131,10 @@ class DevicePrefetcher:
     def _put(self, batch):
         if self._sharding is None:
             return jax.device_put(batch)
-        if jax.process_count() > 1:
-            # Multi-host: each process holds only its local shard of the
-            # global batch; assemble the global array from per-process data
-            # (device_put would wrongly treat the local shard as the whole
-            # global array).
-            return jax.tree.map(
-                lambda x: jax.make_array_from_process_local_data(
-                    self._sharding, x), batch)
-        return jax.device_put(batch, self._sharding)
+        # Batcher yields this process's local shard; assemble the global
+        # array from per-process data (a bare device_put would wrongly
+        # treat the local shard as the whole global array on multi-host).
+        return put_local_batch(batch, self._sharding)
 
     def __iter__(self):
         return self

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from distributedtensorflowexample_tpu.data.pipeline import put_global_batch
 from distributedtensorflowexample_tpu.ops.losses import (
     accuracy, softmax_cross_entropy)
 from distributedtensorflowexample_tpu.training.state import TrainState
@@ -91,30 +92,36 @@ def make_eval_step() -> Callable:
 
 def evaluate(state: TrainState, images, labels, batch_size: int = 1000,
              sharding=None) -> float:
-    """Exact accuracy over a full split, batched to bound HBM use."""
+    """Exact accuracy over a full split, batched to bound HBM use.
+
+    Every process holds the full split (the reference's eval behavior);
+    under multi-host the batch helper keeps only locally-owned rows.
+    """
     eval_step = make_eval_step()
     n = len(labels)
     usable = (n // batch_size) * batch_size
     total_correct = 0
+
+    def put(batch):
+        return put_global_batch(batch, sharding) if sharding is not None else batch
+
     for i in range(0, usable, batch_size):
-        batch = {"image": images[i:i + batch_size],
-                 "label": labels[i:i + batch_size]}
-        if sharding is not None:
-            batch = jax.device_put(batch, sharding)
+        batch = put({"image": images[i:i + batch_size],
+                     "label": labels[i:i + batch_size]})
         correct, _ = eval_step(state, batch)
         total_correct += int(correct)
-    # Remainder evaluated unjitted-shape-safe by padding to batch_size.
+    # Remainder evaluated shape-stable by padding to batch_size with
+    # label -1 (never matches an argmax class).
     rem = n - usable
     if rem:
         import numpy as np
         pad = batch_size - rem
-        batch = {"image": np.concatenate([images[usable:],
-                                          np.zeros((pad,) + images.shape[1:],
-                                                   images.dtype)]),
-                 "label": np.concatenate([labels[usable:],
-                                          np.full((pad,), -1, labels.dtype)])}
-        if sharding is not None:
-            batch = jax.device_put(batch, sharding)
+        batch = put({"image": np.concatenate(
+                         [images[usable:],
+                          np.zeros((pad,) + images.shape[1:], images.dtype)]),
+                     "label": np.concatenate(
+                         [labels[usable:],
+                          np.full((pad,), -1, labels.dtype)])})
         correct, _ = eval_step(state, batch)
         total_correct += int(correct)
     return total_correct / n

@@ -1,0 +1,64 @@
+"""Multi-host bootstrap over real OS processes (SURVEY.md §4: the rebuild's
+version of the reference's 'N processes on localhost' launch).
+
+Spawns 2 python processes with a reference-style TF_CONFIG; each resolves
+the cluster, calls jax.distributed.initialize (Gloo CPU collectives), forms
+one 2-device mesh, and trains config 5 for a few steps.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributedtensorflowexample_tpu.trainers import trainer_multiworker_cifar
+s = trainer_multiworker_cifar.main([
+    "--train_steps", "4", "--batch_size", "4", "--log_dir", {logdir!r},
+    "--data_dir", "/nonexistent", "--resume", "false", "--log_every", "2",
+])
+print("SUMMARY steps=%d replicas=%d acc=%.4f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_tf_config_training(tmp_path):
+    port = _free_port()
+    workers = [f"127.0.0.1:{port}", f"127.0.0.1:{_free_port()}"]
+    procs = []
+    for idx in range(2):
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""   # skip axon TPU registration
+        env["TF_CONFIG"] = (
+            '{"cluster": {"worker": ["%s", "%s"]}, '
+            '"task": {"type": "worker", "index": %d}}'
+            % (workers[0], workers[1], idx))
+        script = _WORKER_SCRIPT.format(logdir=str(tmp_path / f"w{idx}"))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outputs.append(out)
+    finally:
+        for p in procs:   # never leak workers if one hangs
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for idx, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {idx} failed:\n{out}"
+        assert "SUMMARY steps=4 replicas=2" in out, out
+    # Chief-only logging: step lines from process 0 only.
+    assert "step 2:" in outputs[0]
+    assert "step 2:" not in outputs[1]
