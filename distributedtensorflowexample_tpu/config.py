@@ -58,6 +58,9 @@ class RunConfig:
     checkpoint_every: int = 0       # 0 = no periodic checkpoints
     keep_checkpoints: int = 3
     resume: bool = True             # auto-restore latest checkpoint if present
+    profile_dir: str = ""           # "" = no trace; else jax.profiler logdir
+    profile_start_step: int = 10    # first traced step (past compilation)
+    profile_num_steps: int = 5      # trace window length
 
     # --- parallelism ---
     num_devices: int = 0            # 0 = all visible devices

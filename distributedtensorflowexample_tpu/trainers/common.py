@@ -33,6 +33,7 @@ from distributedtensorflowexample_tpu.training.loop import TrainLoop
 from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
 from distributedtensorflowexample_tpu.training.optimizers import build_optimizer
 from distributedtensorflowexample_tpu.training.state import TrainState
+from distributedtensorflowexample_tpu.utils.profiling import ProfilerHook
 
 _SAMPLE_SHAPES = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
 
@@ -110,6 +111,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     eval_fn = (lambda s: _evaluate(consolidate(s))) if is_async else _evaluate
     if cfg.eval_every > 0:
         hooks.append(EvalHook(eval_fn, cfg.eval_every, logger))
+    if cfg.profile_dir:
+        hooks.append(ProfilerHook(cfg.profile_dir, cfg.profile_start_step,
+                                  cfg.profile_num_steps))
 
     train_step = (make_async_train_step(num_replicas, cfg.async_period,
                                         cfg.label_smoothing)

@@ -1,0 +1,17 @@
+"""Cross-cutting utilities: profiling, timing, chief-aware logging.
+
+The reference's observability was library defaults (TF timeline /
+TensorBoard summaries — SURVEY.md §5 "Tracing / profiling"); here the
+equivalents are first-class: ``jax.profiler`` trace capture
+(:mod:`.profiling`), honest device-synchronized timing (:mod:`.timing`),
+and process-0-only logging (:mod:`.logging`).
+"""
+
+from distributedtensorflowexample_tpu.utils.logging import chief_print
+from distributedtensorflowexample_tpu.utils.profiling import (
+    ProfilerHook, trace_context)
+from distributedtensorflowexample_tpu.utils.timing import (
+    RateMeter, Timer, timed_block)
+
+__all__ = ["ProfilerHook", "trace_context", "Timer", "RateMeter",
+           "timed_block", "chief_print"]

@@ -1,0 +1,19 @@
+"""Chief-aware stdout logging.
+
+The reference's multi-process runs printed from every worker; the useful
+convention it followed implicitly — chief (task_index 0) owns user-facing
+output (SURVEY.md §3b control plane) — is made explicit here for the SPMD
+rebuild, where every process runs the identical program.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def chief_print(*args, **kwargs) -> None:
+    """``print`` on process 0 only (safe before distributed init: then
+    process_index() is 0 and it just prints)."""
+    if jax.process_index() == 0:
+        kwargs.setdefault("flush", True)
+        print(*args, **kwargs)

@@ -1,0 +1,67 @@
+"""Trace capture — the TPU-native replacement for TF timeline dumps.
+
+SURVEY.md §5 maps the reference's (absent, library-default) tracing row to
+``jax.profiler`` + TensorBoard.  Two entry points:
+
+* :func:`trace_context` — capture a trace around any code block; view with
+  TensorBoard's profile plugin or Perfetto (``xplane.pb`` under *logdir*).
+* :class:`ProfilerHook` — a training :class:`~..training.hooks.Hook` that
+  captures steps ``(start_step, start_step + num_steps]`` of the live loop,
+  which is how "why is steps/sec low" questions get answered on real chips.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from distributedtensorflowexample_tpu.training.hooks import Hook
+
+
+@contextlib.contextmanager
+def trace_context(logdir: str):
+    """Capture a ``jax.profiler`` trace of the enclosed block into *logdir*."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerHook(Hook):
+    """Trace a window of live training steps.
+
+    Starts capture after step ``start_step`` completes and stops once
+    ``num_steps`` further steps have run, so the window contains exactly the
+    steady-state steps (never compilation, provided ``start_step`` > 0).
+    Chief-only by construction on multi-host: every process traces its own
+    devices into a per-process subdirectory, matching ``jax.profiler``
+    multi-host semantics.
+    """
+
+    def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 5):
+        self._logdir = logdir
+        self._start = max(0, start_step)
+        self._stop = self._start + max(1, num_steps)
+        self._active = False
+
+    def after_step(self, step, state, metrics) -> bool:
+        # >= not ==: after a checkpoint resume the loop may begin past
+        # start_step; the window then starts at the first step seen.
+        if self._start <= step < self._stop and not self._active:
+            # Drain in-flight device work so the trace begins at a step
+            # boundary rather than mid-pipeline.
+            jax.block_until_ready(metrics)
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        elif step >= self._stop and self._active:
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            self._active = False
+        return False
+
+    def end(self, state) -> None:
+        if self._active:  # loop stopped inside the trace window
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,77 @@
+"""Device-honest timing.
+
+Under JAX everything is async: a ``time.perf_counter()`` pair around a step
+call measures dispatch, not compute.  Every timer here takes an optional
+result pytree and ``block_until_ready``'s it before reading the clock, so
+reported seconds are wall-clock the device actually spent.  This is the
+measurement discipline behind the headline steps/sec/chip metric
+(BASELINE.json "metric"; SURVEY.md §5 observability row).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+
+import jax
+
+
+class Timer:
+    """Accumulating timer: ``with timer.measure(result): ...`` style."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    @contextlib.contextmanager
+    def measure(self):
+        t0 = time.perf_counter()
+        out = {}
+        yield out
+        if "result" in out:
+            jax.block_until_ready(out["result"])
+        self.total += time.perf_counter() - t0
+        self.count += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+@contextlib.contextmanager
+def timed_block(label: str = "", sink=None):
+    """Time a block; assign ``out["result"]`` inside to sync on device work.
+
+    ``with timed_block("step") as out: out["result"] = step(...)`` — the
+    result pytree is drained before the clock is read, so async dispatch
+    cannot make the block look faster than the device.
+    """
+    out = {}
+    t0 = time.perf_counter()
+    yield out
+    if "result" in out:
+        jax.block_until_ready(out["result"])
+    dt = time.perf_counter() - t0
+    if sink is not None:
+        sink.append((label, dt))
+    else:
+        print(f"[timing] {label or 'block'}: {dt * 1e3:.2f} ms", flush=True)
+
+
+class RateMeter:
+    """Sliding steps/sec meter over the last window of events."""
+
+    def __init__(self, window: int = 50):
+        self._stamps: collections.deque[float] = collections.deque(
+            maxlen=max(2, window))
+
+    def tick(self) -> None:
+        self._stamps.append(time.perf_counter())
+
+    @property
+    def rate(self) -> float:
+        if len(self._stamps) < 2:
+            return 0.0
+        dt = self._stamps[-1] - self._stamps[0]
+        return (len(self._stamps) - 1) / dt if dt > 0 else 0.0
