@@ -59,7 +59,8 @@ class RunConfig:
     keep_checkpoints: int = 3
     resume: bool = True             # auto-restore latest checkpoint if present
     profile_dir: str = ""           # "" = no trace; else jax.profiler logdir
-    profile_start_step: int = 10    # first traced step (past compilation)
+    profile_start_step: int = 10    # trace starts after this step completes
+                                    # (first traced step is start+1, past compile)
     profile_num_steps: int = 5      # trace window length
 
     # --- parallelism ---

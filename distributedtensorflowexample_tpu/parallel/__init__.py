@@ -1,5 +1,6 @@
 from distributedtensorflowexample_tpu.parallel.mesh import (
-    make_mesh, batch_sharding, replicated_sharding, DATA_AXIS,
+    make_mesh, batch_sharding, replicated_sharding, shard_batch, DATA_AXIS,
 )
 
-__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "DATA_AXIS"]
+__all__ = ["make_mesh", "batch_sharding", "replicated_sharding",
+           "shard_batch", "DATA_AXIS"]

@@ -45,10 +45,19 @@ class ProfilerHook(Hook):
         self._start = max(0, start_step)
         self._stop = self._start + max(1, num_steps)
         self._active = False
+        self._done = False
 
     def after_step(self, step, state, metrics) -> bool:
-        # >= not ==: after a checkpoint resume the loop may begin past
-        # start_step; the window then starts at the first step seen.
+        if self._done:
+            return False
+        if not self._active and step >= self._stop:
+            # Resume landed at/past the window: slide it forward so a
+            # requested trace still captures (stop - start) steady-state
+            # steps instead of silently writing nothing.  One-shot: _done
+            # prevents re-arming after a completed capture.
+            width = self._stop - self._start
+            self._start = step
+            self._stop = step + width
         if self._start <= step < self._stop and not self._active:
             # Drain in-flight device work so the trace begins at a step
             # boundary rather than mid-pipeline.
@@ -59,9 +68,11 @@ class ProfilerHook(Hook):
             jax.block_until_ready(metrics)
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
         return False
 
     def end(self, state) -> None:
         if self._active:  # loop stopped inside the trace window
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True

@@ -18,7 +18,9 @@ import jax
 
 
 class Timer:
-    """Accumulating timer: ``with timer.measure(result): ...`` style."""
+    """Accumulating timer:
+    ``with timer.measure() as out: out["result"] = step(...)`` —
+    the result pytree is drained before the clock stops."""
 
     def __init__(self):
         self.total = 0.0
@@ -26,12 +28,10 @@ class Timer:
 
     @contextlib.contextmanager
     def measure(self):
-        t0 = time.perf_counter()
-        out = {}
-        yield out
-        if "result" in out:
-            jax.block_until_ready(out["result"])
-        self.total += time.perf_counter() - t0
+        sink: list[tuple[str, float]] = []
+        with timed_block(sink=sink) as out:
+            yield out
+        self.total += sink[0][1]
         self.count += 1
 
     @property

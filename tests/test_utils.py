@@ -29,6 +29,33 @@ def test_profiler_hook_window(tmp_path):
                      recursive=True)
 
 
+def test_profiler_hook_slides_window_on_resume(tmp_path):
+    """A run resuming past the configured window still captures a trace."""
+    logdir = str(tmp_path / "resumed")
+    hook = ProfilerHook(logdir, start_step=2, num_steps=2)
+    m = jnp.zeros(())
+    for step in range(50, 56):  # checkpoint resume landed at step 50
+        hook.after_step(step, None, m)
+    hook.end(None)
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_profiler_hook_is_one_shot(tmp_path, monkeypatch):
+    """After the window completes, tracing must never re-arm."""
+    import distributedtensorflowexample_tpu.utils.profiling as prof
+    starts = []
+    monkeypatch.setattr(prof.jax.profiler, "start_trace",
+                        lambda d: starts.append(d))
+    monkeypatch.setattr(prof.jax.profiler, "stop_trace", lambda: None)
+    hook = ProfilerHook(str(tmp_path), start_step=2, num_steps=2)
+    m = jnp.zeros(())
+    for step in range(1, 30):
+        hook.after_step(step, None, m)
+    hook.end(None)
+    assert len(starts) == 1
+
+
 def test_profiler_hook_stops_on_early_end(tmp_path):
     logdir = str(tmp_path / "early")
     hook = ProfilerHook(logdir, start_step=1, num_steps=100)
