@@ -70,6 +70,10 @@ class RunConfig:
     replicas_to_aggregate: int = 0  # SyncReplicasOptimizer compat; 0 = all
     dtype: str = "bfloat16"         # compute dtype on TPU (params stay f32)
 
+    # --- hand-written TPU kernels (ops/pallas) ---
+    pallas_ce: bool = False         # fused Pallas loss head in the train step
+    fused_optimizer: bool = False   # fused Pallas momentum-SGD apply
+
     @property
     def ps_host_list(self) -> list[str]:
         return [h for h in self.ps_hosts.split(",") if h]

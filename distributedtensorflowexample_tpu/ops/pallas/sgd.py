@@ -1,0 +1,147 @@
+"""Fused momentum-SGD update Pallas kernel (the per-step optimizer apply).
+
+The reference's update was a native TF ``ApplyMomentum`` op per variable
+(library C++, SURVEY.md §2 native-dependency table).  This kernel is the
+TPU equivalent: for each parameter leaf, one VMEM pass computes
+
+    m_new = mu * m + g          (optax.sgd(momentum=mu) trace semantics)
+    p_new = p - lr * m_new
+
+in one fused pass per leaf.  ``input_output_aliases`` lets XLA reuse the
+kernel operands' buffers for the outputs; note the operands here are the
+padded/flattened temporaries built around the kernel, so the aliasing
+saves the kernel-internal copies, not the whole-step HBM round-trip.
+``lr`` arrives as a traced (1, 1) SMEM scalar so LR schedules stay
+dynamic; ``mu`` is compile-time static.
+
+Leaves are flattened and padded to (rows, 128) lanes; the pad tail is
+updated too (momentum of a zero-gradient pad stays zero, params stay put),
+so no masking is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributedtensorflowexample_tpu.ops.pallas.tiling import (
+    LANES as _LANES, pick_block)
+
+_ROW_BLOCK = 1024     # 1024x128 f32 = 512 KiB per operand block in VMEM
+
+
+def _sgd_kernel(lr_ref, p_ref, m_ref, g_ref, p_out, m_out, *, mu: float):
+    lr = lr_ref[0, 0]
+    m_new = mu * m_ref[:] + g_ref[:]
+    p_out[:] = p_ref[:] - lr * m_new
+    m_out[:] = m_new
+
+
+def _pick_block(rows: int) -> int:
+    return pick_block(rows, _ROW_BLOCK)
+
+
+def _apply_leaf(param, mom, grad, lr2d, mu: float, interpret: bool):
+    shape, dtype, n = param.shape, param.dtype, param.size
+    rows = max(8, (n + _LANES - 1) // _LANES)
+    rows = ((rows + 7) // 8) * 8
+    padded = rows * _LANES
+
+    def flat(x):
+        x = x.astype(jnp.float32).reshape(-1)
+        return jnp.pad(x, (0, padded - n)).reshape(rows, _LANES)
+
+    block = _pick_block(rows)
+    grid = (rows // block,)
+    spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    p_new, m_new = pl.pallas_call(
+        functools.partial(_sgd_kernel, mu=mu),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            spec, spec, spec,
+        ],
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)),
+        input_output_aliases={1: 0, 2: 1},
+        interpret=interpret,
+    )(lr2d, flat(param), flat(mom), flat(grad))
+    unflat = lambda x: x.reshape(-1)[:n].reshape(shape).astype(dtype)
+    return unflat(p_new), unflat(m_new)
+
+
+class FusedSgdState(NamedTuple):
+    count: jnp.ndarray     # step counter for LR schedules
+    trace: object          # momentum tree, same structure as params
+
+
+def fused_momentum_sgd(learning_rate, momentum: float = 0.9, mesh=None):
+    """Optax-compatible transformation backed by the fused Pallas kernel.
+
+    Same math as ``optax.sgd(learning_rate, momentum=momentum)``, but the
+    state pytree differs (``FusedSgdState`` vs optax's tuple), so a
+    checkpoint written with one cannot be restored with the other — pick
+    the flag per run, not mid-experiment.  The optax contract returns
+    *updates* (applied by ``optax.apply_updates``), so the kernel's result
+    is expressed as ``p_new - p``; XLA folds the add/sub pair away.
+
+    A ``pallas_call`` is a custom call XLA cannot auto-partition: on a
+    multi-device mesh pass ``mesh`` so the kernel runs per-device under
+    ``jax.shard_map`` (all operands are replicated in data parallelism, so
+    every device performs the identical update).
+    """
+    import optax
+
+    def init(params):
+        return FusedSgdState(count=jnp.zeros([], jnp.int32),
+                             trace=jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_momentum_sgd requires params")
+        lr = learning_rate(state.count) if callable(learning_rate) \
+            else learning_rate
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+            apply = jax.shard_map(
+                lambda p, m, g, lr_: fused_sgd_apply(p, m, g, lr_, momentum),
+                mesh=mesh, in_specs=(P(), P(), P(), P()),
+                out_specs=(P(), P()), check_vma=False)
+            p_new, m_new = apply(params, state.trace, grads,
+                                 jnp.asarray(lr, jnp.float32))
+        else:
+            p_new, m_new = fused_sgd_apply(params, state.trace, grads, lr,
+                                           momentum)
+        updates = jax.tree.map(lambda a, b: a - b, p_new, params)
+        return updates, FusedSgdState(count=state.count + 1, trace=m_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+def fused_sgd_apply(params, momentum, grads, lr, mu: float = 0.9,
+                    interpret: bool | None = None):
+    """Apply one momentum-SGD step to every leaf; returns (params, momentum).
+
+    ``lr`` may be a traced scalar (schedule output).  ``interpret=None``
+    auto-selects interpret mode off-TPU for CPU testing.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    lr2d = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    leaves_p, treedef = jax.tree.flatten(params)
+    leaves_m = treedef.flatten_up_to(momentum)
+    leaves_g = treedef.flatten_up_to(grads)
+    out_p, out_m = [], []
+    for p, m, g in zip(leaves_p, leaves_m, leaves_g):
+        np_, nm = _apply_leaf(p, m, g, lr2d, float(mu), interpret)
+        out_p.append(np_)
+        out_m.append(nm)
+    return treedef.unflatten(out_p), treedef.unflatten(out_m)

@@ -22,13 +22,39 @@ import jax.numpy as jnp
 import optax
 
 from distributedtensorflowexample_tpu.data.pipeline import put_global_batch
+from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
 from distributedtensorflowexample_tpu.ops.losses import (
     accuracy, softmax_cross_entropy)
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
-def make_train_step(label_smoothing: float = 0.0) -> Callable:
-    """Build the jitted (state, batch) -> (state, metrics) step."""
+def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
+                    mesh=None) -> Callable:
+    """Build the jitted (state, batch) -> (state, metrics) step.
+
+    ``ce_impl="pallas"`` swaps the loss head for the fused Pallas kernel
+    (ops/pallas/cross_entropy.py).  A ``pallas_call`` is a custom call XLA
+    cannot auto-partition, so on a multi-device mesh the kernel runs
+    per-shard under ``jax.shard_map`` over the batch axis; the batch mean
+    outside it remains an ordinary jnp op, keeping the gradient psum
+    identical to the XLA path.
+    """
+    if ce_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown ce_impl {ce_impl!r}")
+
+    def compute_loss(logits, labels):
+        if ce_impl == "xla":
+            return softmax_cross_entropy(logits, labels, label_smoothing)
+        from distributedtensorflowexample_tpu.ops.pallas import (
+            fused_softmax_cross_entropy_rows)
+        fused = lambda l, y: fused_softmax_cross_entropy_rows(
+            l, y, label_smoothing)
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+            fused = jax.shard_map(fused, mesh=mesh,
+                                  in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                                  out_specs=P(DATA_AXIS), check_vma=False)
+        return jnp.mean(fused(logits, labels))
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -46,7 +72,7 @@ def make_train_step(label_smoothing: float = 0.0) -> Callable:
                 logits = state.apply_fn(variables, batch["image"], train=True,
                                         rngs={"dropout": step_rng})
                 new_stats = state.batch_stats
-            loss = softmax_cross_entropy(logits, batch["label"], label_smoothing)
+            loss = compute_loss(logits, batch["label"])
             return loss, (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(

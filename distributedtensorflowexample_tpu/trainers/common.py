@@ -49,6 +49,11 @@ def _load_dataset(cfg: RunConfig, name: str, split: str):
 def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                  augment: bool = False) -> dict:
     """Train per config; returns a summary dict (used by tests and bench)."""
+    if cfg.sync_mode == "async" and cfg.pallas_ce:
+        # The async step vmaps over virtual workers; the Pallas loss head
+        # is only wired into the sync step. Fail fast (pure-cfg check)
+        # rather than let a benchmark silently measure the XLA path.
+        raise ValueError("--pallas_ce is not supported with sync_mode=async")
     info = cluster.resolve(cfg)
     if info.role == "ps":
         print(cluster.PS_NOTICE, flush=True)
@@ -74,7 +79,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     model = build_model(model_name, dropout=cfg.dropout,
                         dtype=jnp.dtype(cfg.dtype))
-    tx = build_optimizer(cfg)
+    tx = build_optimizer(cfg, mesh=mesh)
     sample_shape = (global_batch,) + _SAMPLE_SHAPES[dataset_name]
     state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
 
@@ -115,9 +120,11 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         hooks.append(ProfilerHook(cfg.profile_dir, cfg.profile_start_step,
                                   cfg.profile_num_steps))
 
+    ce_impl = "pallas" if cfg.pallas_ce else "xla"
     train_step = (make_async_train_step(num_replicas, cfg.async_period,
                                         cfg.label_smoothing)
-                  if is_async else make_train_step(cfg.label_smoothing))
+                  if is_async else make_train_step(cfg.label_smoothing,
+                                                   ce_impl=ce_impl, mesh=mesh))
     with mesh:
         loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger)
         state = loop.run(state)

@@ -38,8 +38,19 @@ def build_schedule(cfg: RunConfig) -> optax.Schedule:
     return sched
 
 
-def build_optimizer(cfg: RunConfig) -> optax.GradientTransformation:
+def build_optimizer(cfg: RunConfig,
+                    mesh=None) -> optax.GradientTransformation:
     sched = build_schedule(cfg)
+    if cfg.fused_optimizer:
+        if cfg.momentum <= 0.0 or cfg.weight_decay > 0.0:
+            raise ValueError(
+                "--fused_optimizer implements momentum SGD only; it needs "
+                f"momentum > 0 (got {cfg.momentum}) and weight_decay == 0 "
+                f"(got {cfg.weight_decay})")
+        # Hand-written Pallas apply (ops/pallas/sgd.py); optax-compatible.
+        from distributedtensorflowexample_tpu.ops.pallas import (
+            fused_momentum_sgd)
+        return fused_momentum_sgd(sched, cfg.momentum, mesh=mesh)
     if cfg.momentum > 0.0:
         tx = optax.sgd(sched, momentum=cfg.momentum, nesterov=False)
     else:

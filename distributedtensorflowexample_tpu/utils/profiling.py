@@ -50,10 +50,10 @@ class ProfilerHook(Hook):
     def after_step(self, step, state, metrics) -> bool:
         if self._done:
             return False
-        if not self._active and step >= self._stop:
-            # Resume landed at/past the window: slide it forward so a
-            # requested trace still captures (stop - start) steady-state
-            # steps instead of silently writing nothing.  One-shot: _done
+        if not self._active and step > self._start:
+            # Resume landed inside or past the window: slide it forward so
+            # a requested trace still captures (stop - start) steady-state
+            # steps instead of a truncated or empty one.  One-shot: _done
             # prevents re-arming after a completed capture.
             width = self._stop - self._start
             self._start = step

@@ -1,0 +1,166 @@
+"""Pallas kernel parity tests (interpret mode on CPU — SURVEY.md §4).
+
+Each kernel is checked value- and gradient-exact against the pure-jnp
+reference implementation it replaces.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflowexample_tpu.ops.losses import softmax_cross_entropy
+from distributedtensorflowexample_tpu.ops.pallas import (
+    fused_sgd_apply, fused_softmax_cross_entropy_rows)
+
+
+def _ref_rows(logits, labels, smoothing=0.0):
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    if smoothing > 0.0:
+        onehot = onehot * (1.0 - smoothing) + smoothing / num_classes
+    return -jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+
+
+@pytest.mark.parametrize("batch,classes", [(32, 10), (64, 100), (24, 10)])
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_ce_rows_match_reference(batch, classes, smoothing):
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(batch, classes).astype(np.float32)) * 5
+    labels = jnp.asarray(rng.randint(0, classes, size=batch, dtype=np.int32))
+    got = fused_softmax_cross_entropy_rows(logits, labels, smoothing)
+    want = _ref_rows(logits, labels, smoothing)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_ce_gradient_matches_reference(smoothing):
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(32, 10).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=32, dtype=np.int32))
+
+    def fused(l):
+        return jnp.mean(fused_softmax_cross_entropy_rows(l, labels, smoothing))
+
+    def ref(l):
+        return softmax_cross_entropy(l, labels, smoothing)
+
+    v1, g1 = jax.value_and_grad(fused)(logits)
+    v2, g2 = jax.value_and_grad(ref)(logits)
+    np.testing.assert_allclose(v1, v2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_ce_jit_and_weighted_vjp():
+    # Non-uniform cotangent exercises the per-row backward scaling.
+    rng = np.random.RandomState(2)
+    logits = jnp.asarray(rng.randn(16, 10).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=16, dtype=np.int32))
+    w = jnp.linspace(0.1, 2.0, 16)
+
+    @jax.jit
+    def fused(l):
+        return jnp.sum(w * fused_softmax_cross_entropy_rows(l, labels))
+
+    def ref(l):
+        return jnp.sum(w * _ref_rows(l, labels))
+
+    np.testing.assert_allclose(jax.grad(fused)(logits), jax.grad(ref)(logits),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _tree():
+    rng = np.random.RandomState(3)
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32))
+    return {"conv": {"kernel": mk(5, 5, 1, 32), "bias": mk(32)},
+            "dense": {"kernel": mk(300, 7), "bias": mk(7)}}
+
+
+@pytest.mark.parametrize("mu", [0.0, 0.9])
+def test_fused_sgd_matches_optax(mu):
+    params, grads, mom = _tree(), _tree(), jax.tree.map(jnp.zeros_like, _tree())
+    mom = jax.tree.map(lambda x: x * 0.5, _tree())
+    lr = 0.13
+    p_new, m_new = fused_sgd_apply(params, mom, grads, lr, mu)
+
+    # optax.sgd(momentum=mu): m_t = mu*m + g ; update = -lr*m_t
+    want_m = jax.tree.map(lambda m, g: mu * m + g, mom, grads)
+    want_p = jax.tree.map(lambda p, m: p - lr * m, params, want_m)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+                 p_new, want_p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+                 m_new, want_m)
+
+
+def test_fused_sgd_traced_lr_under_jit():
+    params, grads = _tree(), _tree()
+    mom = jax.tree.map(jnp.zeros_like, params)
+    sched = optax.cosine_decay_schedule(0.1, 100)
+
+    @jax.jit
+    def step(params, mom, grads, count):
+        return fused_sgd_apply(params, mom, grads, sched(count), 0.9)
+
+    p_new, m_new = step(params, mom, grads, jnp.asarray(7))
+    lr = float(sched(7))
+    want_p = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+                 p_new, want_p)
+
+
+def test_pallas_step_matches_xla_step_on_mesh():
+    """Full sync-DP train step with both Pallas paths on the 8-device mesh
+    matches the XLA step numerically (same batch, same init)."""
+    from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.ops.pallas import fused_momentum_sgd
+    from distributedtensorflowexample_tpu.parallel import (
+        batch_sharding, make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.sync import make_train_step
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    mesh = make_mesh()
+    x, y = make_synthetic(64, (28, 28, 1), 10, seed=0)
+    batch = jax.device_put({"image": x, "label": y}, batch_sharding(mesh))
+    model = build_model("softmax")
+
+    def run(tx, **step_kw):
+        state = TrainState.create_sharded(model, tx, (64, 28, 28, 1), 0,
+                                          replicated_sharding(mesh))
+        with mesh:
+            state, metrics = make_train_step(**step_kw)(state, batch)
+        return state, metrics
+
+    s_ref, m_ref = run(optax.sgd(0.1, momentum=0.9))
+    s_pal, m_pal = run(fused_momentum_sgd(0.1, momentum=0.9, mesh=mesh),
+                       ce_impl="pallas", mesh=mesh)
+    np.testing.assert_allclose(float(m_ref["loss"]), float(m_pal["loss"]),
+                               rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                         atol=1e-6),
+                 s_ref.params, s_pal.params)
+
+
+def test_fused_optimizer_flag_rejects_incompatible_config():
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.training.optimizers import (
+        build_optimizer)
+
+    with pytest.raises(ValueError, match="momentum"):
+        build_optimizer(RunConfig(fused_optimizer=True, momentum=0.0))
+    with pytest.raises(ValueError, match="weight_decay"):
+        build_optimizer(RunConfig(fused_optimizer=True, momentum=0.9,
+                                  weight_decay=1e-4))
+
+
+def test_pallas_ce_rejected_in_async_mode(tmp_path):
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    cfg = RunConfig(sync_mode="async", pallas_ce=True, train_steps=1,
+                    batch_size=64, global_batch=True, dataset="mnist",
+                    data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                    resume=False)
+    with pytest.raises(ValueError, match="pallas_ce"):
+        run_training(cfg, "softmax", "mnist")
