@@ -35,22 +35,34 @@ def _load_binary_batches(data_dir: str, split: str):
         return None
     names = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
              else ["test_batch"])
+    from distributedtensorflowexample_tpu import native
+
+    def to_nhwc(chw_rows: np.ndarray) -> np.ndarray:
+        """[N, 3072] uint8 CHW rows -> [N,32,32,3] float32 in [0,1]."""
+        nhwc = chw_rows.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return nhwc.astype(np.float32) / 255.0
+
     images, labels = [], []
     for name in names:
         path = os.path.join(base, name)
         if os.path.exists(path):          # python pickle layout
             with open(path, "rb") as f:
                 d = pickle.load(f, encoding="bytes")
-            images.append(np.asarray(d[b"data"], dtype=np.uint8))
+            images.append(to_nhwc(np.asarray(d[b"data"], dtype=np.uint8)))
             labels.append(np.asarray(d[b"labels"], dtype=np.int32))
         elif os.path.exists(path + ".bin"):  # binary layout: 1 label byte + 3072
-            raw = np.fromfile(path + ".bin", dtype=np.uint8).reshape(-1, 3073)
-            labels.append(raw[:, 0].astype(np.int32))
-            images.append(raw[:, 1:])
+            with open(path + ".bin", "rb") as f:
+                raw = f.read()
+            if native.available():        # C++ parse straight to NHWC float
+                imgs, lbls = native.parse_cifar(raw)
+            else:
+                rows = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3073)
+                imgs, lbls = to_nhwc(rows[:, 1:]), rows[:, 0].astype(np.int32)
+            images.append(imgs)
+            labels.append(lbls)
         else:
             return None
-    images = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-    return images.astype(np.float32) / 255.0, np.concatenate(labels)
+    return np.concatenate(images), np.concatenate(labels)
 
 
 def load_cifar10(data_dir: str, split: str = "train",
@@ -71,18 +83,49 @@ def load_cifar10(data_dir: str, split: str = "train",
 def augment(images: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
     """Random 4px-pad crop + horizontal flip, the reference's augmentations.
 
-    Fully vectorized (one strided-window gather + one masked flip): this
-    runs on the host per training step, so a per-image Python loop would
-    serialize the input pipeline at exactly the scale where the TPU is
-    fastest (see pipeline.py docstring).
+    Runs on the host every training step.  The random draws happen here in
+    a fixed order (ys, xs, flips), then the pixel work dispatches to the
+    native C++ loader when built (one fused OpenMP pass, no padded
+    intermediate) or to a fully-vectorized numpy fallback — both produce
+    bit-identical batches for a given rng state.
     """
+    ys, xs, flips = _draw(rng, images.shape[0])
+    from distributedtensorflowexample_tpu import native
+    if native.available():
+        return native.augment_crop_flip(images, ys, xs, flips)
+    return _augment_numpy(images, ys, xs, flips)
+
+
+def _draw(rng: np.random.RandomState, n: int):
+    """The augmentation's random draws, in one fixed order — shared by the
+    plain, native, and fused paths so all are bit-identical per rng state."""
+    ys = rng.randint(0, 9, size=n)
+    xs = rng.randint(0, 9, size=n)
+    flips = rng.rand(n) < 0.5
+    return ys, xs, flips
+
+
+def _fused_gather_augment(src: np.ndarray, idx: np.ndarray,
+                          rng: np.random.RandomState) -> np.ndarray:
+    """Native single-pass gather+crop+flip (dataio.cc gather_augment_f32):
+    batch rows are pulled from the training array and augmented straight
+    into the output, skipping the intermediate gathered copy."""
+    from distributedtensorflowexample_tpu import native
+    return native.gather_augment(src, idx, *_draw(rng, idx.size))
+
+
+# Batcher fuses the gather with this augmentation when native is available
+# (see pipeline.Batcher._gather); draws stay in the same order as augment().
+augment.fused_native = _fused_gather_augment
+
+
+def _augment_numpy(images: np.ndarray, ys: np.ndarray, xs: np.ndarray,
+                   flips: np.ndarray) -> np.ndarray:
+    """Vectorized fallback (one strided-window gather + one masked flip)."""
     n, h, w, _ = images.shape
     padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
     # windows: [n, 9, 9, c, h, w] view; fancy-index one crop per image.
     windows = np.lib.stride_tricks.sliding_window_view(padded, (h, w), axis=(1, 2))
-    ys = rng.randint(0, 9, size=n)
-    xs = rng.randint(0, 9, size=n)
     crops = windows[np.arange(n), ys, xs]          # [n, c, h, w] (copy)
     crops = np.moveaxis(crops, 1, -1)              # back to NHWC
-    flips = (rng.rand(n) < 0.5)[:, None, None, None]
-    return np.where(flips, crops[:, :, ::-1, :], crops)
+    return np.where(flips[:, None, None, None], crops[:, :, ::-1, :], crops)

@@ -32,19 +32,27 @@ def _open_maybe_gz(path: str):
 
 def _read_idx_images(path: str) -> np.ndarray:
     with _open_maybe_gz(path) as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        if magic != 2051:
-            raise ValueError(f"bad IDX image magic {magic} in {path}")
-        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        raw = f.read()
+    from distributedtensorflowexample_tpu import native
+    if native.available():
+        return native.parse_idx_images(raw)
+    magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
+    if magic != 2051:
+        raise ValueError(f"bad IDX image magic {magic} in {path}")
+    data = np.frombuffer(raw, dtype=np.uint8, count=n * rows * cols, offset=16)
     return data.reshape(n, rows, cols, 1).astype(np.float32) / 255.0
 
 
 def _read_idx_labels(path: str) -> np.ndarray:
     with _open_maybe_gz(path) as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        if magic != 2049:
-            raise ValueError(f"bad IDX label magic {magic} in {path}")
-        return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int32)
+        raw = f.read()
+    from distributedtensorflowexample_tpu import native
+    if native.available():
+        return native.parse_idx_labels(raw)
+    magic, n = struct.unpack(">II", raw[:8])
+    if magic != 2049:
+        raise ValueError(f"bad IDX label magic {magic} in {path}")
+    return np.frombuffer(raw, dtype=np.uint8, count=n, offset=8).astype(np.int32)
 
 
 def load_mnist(data_dir: str, split: str = "train",

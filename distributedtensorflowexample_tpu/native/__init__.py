@@ -1,0 +1,25 @@
+"""Native (C++) runtime components.
+
+The reference's runtime work below the Python layer was TensorFlow library
+C++ (SURVEY.md §2: tf.data input kernels, gRPC runtime, NCCL).  On TPU the
+compute/collective side of that is XLA+libtpu; the host-side input stack is
+ours, and lives here as a C++ shared library with ctypes bindings
+(``dataio.cc`` + ``loader.py``): dataset parsing, parallel batch gather,
+and fused gather+augmentation.  Pure-numpy fallbacks keep every feature
+working when the toolchain is absent.
+"""
+
+from distributedtensorflowexample_tpu.native.loader import (
+    augment_crop_flip, available, gather, gather_augment, omp_threads,
+    parse_cifar, parse_idx_images, parse_idx_labels)
+
+__all__ = [
+    "augment_crop_flip",
+    "available",
+    "gather",
+    "gather_augment",
+    "omp_threads",
+    "parse_cifar",
+    "parse_idx_images",
+    "parse_idx_labels",
+]

@@ -1,0 +1,186 @@
+// Native data-loader runtime for the TPU framework.
+//
+// The reference fed its trainers through TensorFlow's native input stack
+// (tf.data C++ kernels / input_data readers — SURVEY.md §2 C10/C11, native
+// dependency table).  This is the TPU-native equivalent: the per-step
+// host-side work — dataset parsing, shuffled batch gather, CIFAR crop/flip
+// augmentation — done in C++ with OpenMP, so the host never becomes the
+// bottleneck that kills scaling at MNIST-sized per-step compute
+// (SURVEY.md §7 "hard parts").
+//
+// Randomness is drawn by the Python caller and passed in (crop offsets,
+// flip bits), so the native and numpy paths are bit-identical and runs
+// stay deterministic per seed.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -fopenmp (see loader.py).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+// Big-endian u32 read (IDX headers are big-endian).
+inline uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// numpy 'reflect' padding index map for pad=4: padded coord p -> source
+// coord in [0, n): mirror without repeating the edge sample.
+inline int64_t reflect4(int64_t p, int64_t n) {
+  int64_t m = p - 4;
+  if (m < 0) m = -m;
+  if (m >= n) m = 2 * n - 2 - m;
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- IDX (MNIST) ----------------------------------------------------------
+
+// Header query. Returns 0 on success, nonzero error code otherwise.
+int idx_images_dims(const unsigned char* buf, size_t len, int64_t* n,
+                    int64_t* rows, int64_t* cols) {
+  if (len < 16 || be32(buf) != 2051) return 1;
+  *n = be32(buf + 4);
+  *rows = be32(buf + 8);
+  *cols = be32(buf + 12);
+  if (len < 16 + size_t(*n) * size_t(*rows) * size_t(*cols)) return 2;
+  return 0;
+}
+
+// Parse pixels into out[n*rows*cols] floats scaled to [0, 1].
+int idx_images_parse(const unsigned char* buf, size_t len, float* out) {
+  int64_t n, rows, cols;
+  int rc = idx_images_dims(buf, len, &n, &rows, &cols);
+  if (rc) return rc;
+  const unsigned char* px = buf + 16;
+  const int64_t total = n * rows * cols;
+  // Divide (not multiply-by-reciprocal): bit-identical to numpy's /255.
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < total; ++i) out[i] = float(px[i]) / 255.0f;
+  return 0;
+}
+
+int idx_labels_dims(const unsigned char* buf, size_t len, int64_t* n) {
+  if (len < 8 || be32(buf) != 2049) return 1;
+  *n = be32(buf + 4);
+  if (len < 8 + size_t(*n)) return 2;
+  return 0;
+}
+
+int idx_labels_parse(const unsigned char* buf, size_t len, int32_t* out) {
+  int64_t n;
+  int rc = idx_labels_dims(buf, len, &n);
+  if (rc) return rc;
+  const unsigned char* p = buf + 8;
+  for (int64_t i = 0; i < n; ++i) out[i] = int32_t(p[i]);
+  return 0;
+}
+
+// ---- CIFAR-10 binary ------------------------------------------------------
+
+// Records of [label u8][3072 u8, CHW].  Emits NHWC floats in [0, 1] and
+// int32 labels.  n_records = len / 3073.
+int cifar_parse(const unsigned char* buf, size_t len, float* out_images,
+                int32_t* out_labels) {
+  if (len % 3073 != 0) return 1;
+  const int64_t n = int64_t(len / 3073);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const unsigned char* rec = buf + i * 3073;
+    out_labels[i] = int32_t(rec[0]);
+    const unsigned char* chw = rec + 1;
+    float* img = out_images + i * 3072;
+    for (int64_t y = 0; y < 32; ++y)
+      for (int64_t x = 0; x < 32; ++x)
+        for (int64_t c = 0; c < 3; ++c)
+          img[(y * 32 + x) * 3 + c] = float(chw[c * 1024 + y * 32 + x]) / 255.0f;
+  }
+  return 0;
+}
+
+// ---- Batch assembly -------------------------------------------------------
+
+// out[i, :] = src[idx[i], :] — the per-step shuffled-minibatch gather.
+void gather_f32(const float* src, const int64_t* idx, int64_t batch,
+                int64_t row_elems, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < batch; ++i)
+    std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                size_t(row_elems) * sizeof(float));
+}
+
+void gather_i32(const int32_t* src, const int64_t* idx, int64_t batch,
+                int32_t* out) {
+  for (int64_t i = 0; i < batch; ++i) out[i] = src[idx[i]];
+}
+
+// ---- CIFAR train augmentation --------------------------------------------
+
+// Random crop from a reflect-padded (pad=4) image + horizontal flip,
+// fused: the padded image is never materialized.  src/out are
+// [batch, h, w, c] f32; ys/xs in [0, 8], flips in {0, 1}.
+void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
+                       int64_t c, const int32_t* ys, const int32_t* xs,
+                       const uint8_t* flips, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < batch; ++i) {
+    const float* img = src + i * h * w * c;
+    float* dst = out + i * h * w * c;
+    const int64_t y0 = ys[i], x0 = xs[i];
+    const bool flip = flips[i] != 0;
+    for (int64_t y = 0; y < h; ++y) {
+      const int64_t sy = reflect4(y0 + y, h);
+      for (int64_t x = 0; x < w; ++x) {
+        const int64_t ox = flip ? (w - 1 - x) : x;
+        const int64_t sx = reflect4(x0 + ox, w);
+        const float* s = img + (sy * w + sx) * c;
+        float* d = dst + (y * w + x) * c;
+        for (int64_t ch = 0; ch < c; ++ch) d[ch] = s[ch];
+      }
+    }
+  }
+}
+
+// Gather + augment in one pass: rows are pulled from the full training
+// array and augmented straight into the output batch (no intermediate
+// batch copy).
+void gather_augment_f32(const float* src, const int64_t* idx, int64_t batch,
+                        int64_t h, int64_t w, int64_t c, const int32_t* ys,
+                        const int32_t* xs, const uint8_t* flips, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < batch; ++i) {
+    const float* img = src + idx[i] * h * w * c;
+    float* dst = out + i * h * w * c;
+    const int64_t y0 = ys[i], x0 = xs[i];
+    const bool flip = flips[i] != 0;
+    for (int64_t y = 0; y < h; ++y) {
+      const int64_t sy = reflect4(y0 + y, h);
+      for (int64_t x = 0; x < w; ++x) {
+        const int64_t ox = flip ? (w - 1 - x) : x;
+        const int64_t sx = reflect4(x0 + ox, w);
+        const float* s = img + (sy * w + sx) * c;
+        float* d = dst + (y * w + x) * c;
+        for (int64_t ch = 0; ch < c; ++ch) d[ch] = s[ch];
+      }
+    }
+  }
+}
+
+int omp_max_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

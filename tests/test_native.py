@@ -1,0 +1,158 @@
+"""Native C++ data-loader parity tests (SURVEY.md §2 C10/C11 rebuild).
+
+Every native entry point is checked bit-exact against the numpy fallback
+it replaces — the two paths must be indistinguishable to training.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from distributedtensorflowexample_tpu import native
+from distributedtensorflowexample_tpu.data.cifar10 import _augment_numpy
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _idx_image_bytes(n=50, rows=28, cols=28, seed=0):
+    rng = np.random.RandomState(seed)
+    pixels = rng.randint(0, 256, size=n * rows * cols, dtype=np.uint8)
+    return struct.pack(">IIII", 2051, n, rows, cols) + pixels.tobytes(), pixels
+
+
+def _idx_label_bytes(n=50, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n, dtype=np.uint8)
+    return struct.pack(">II", 2049, n) + labels.tobytes(), labels
+
+
+def test_idx_image_parse_matches_numpy():
+    raw, pixels = _idx_image_bytes()
+    got = native.parse_idx_images(raw)
+    want = pixels.reshape(50, 28, 28, 1).astype(np.float32) / 255.0
+    np.testing.assert_array_equal(got, want)
+
+
+def test_idx_label_parse_matches_numpy():
+    raw, labels = _idx_label_bytes()
+    np.testing.assert_array_equal(native.parse_idx_labels(raw),
+                                  labels.astype(np.int32))
+
+
+def test_idx_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        native.parse_idx_images(b"\x00" * 32)
+    with pytest.raises(ValueError):
+        native.parse_idx_labels(b"\x00" * 32)
+
+
+def test_cifar_parse_matches_numpy():
+    rng = np.random.RandomState(1)
+    n = 20
+    recs = rng.randint(0, 256, size=(n, 3073), dtype=np.uint8)
+    recs[:, 0] = rng.randint(0, 10, size=n)
+    got_imgs, got_lbls = native.parse_cifar(recs.tobytes())
+    want = (recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+            .astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(got_imgs, want)
+    np.testing.assert_array_equal(got_lbls, recs[:, 0].astype(np.int32))
+
+
+def test_gather_f32_matches_fancy_indexing():
+    rng = np.random.RandomState(2)
+    src = rng.randn(500, 28, 28, 1).astype(np.float32)
+    idx = rng.randint(0, 500, size=128)
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_gather_i32_matches_fancy_indexing():
+    rng = np.random.RandomState(3)
+    src = rng.randint(0, 10, size=500).astype(np.int32)
+    idx = rng.randint(0, 500, size=128)
+    np.testing.assert_array_equal(native.gather(src, idx), src[idx])
+
+
+def test_augment_matches_numpy_fallback():
+    rng = np.random.RandomState(4)
+    images = rng.randn(32, 32, 32, 3).astype(np.float32)
+    ys = rng.randint(0, 9, size=32).astype(np.int32)
+    xs = rng.randint(0, 9, size=32).astype(np.int32)
+    flips = rng.rand(32) < 0.5
+    got = native.augment_crop_flip(images, ys, xs, flips)
+    want = _augment_numpy(images, ys, xs, flips)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fused_gather_augment_matches_two_step():
+    rng = np.random.RandomState(5)
+    src = rng.randn(200, 32, 32, 3).astype(np.float32)
+    idx = rng.randint(0, 200, size=64)
+    ys = rng.randint(0, 9, size=64).astype(np.int32)
+    xs = rng.randint(0, 9, size=64).astype(np.int32)
+    flips = rng.rand(64) < 0.5
+    got = native.gather_augment(src, idx, ys, xs, flips)
+    want = _augment_numpy(src[idx], ys, xs, flips)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_mnist_loader_uses_native_and_matches(tmp_path):
+    """End-to-end: IDX files on disk parse identically through load_mnist."""
+    from distributedtensorflowexample_tpu.data.mnist import load_mnist
+
+    img_raw, pixels = _idx_image_bytes(n=40)
+    lbl_raw, labels = _idx_label_bytes(n=40)
+    with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(img_raw)
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(lbl_raw)
+    x, y = load_mnist(str(tmp_path), "train")
+    np.testing.assert_array_equal(
+        x, pixels.reshape(40, 28, 28, 1).astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+
+
+def test_batcher_native_gather_parity():
+    """Batcher yields identical batches whether or not native is used."""
+    from distributedtensorflowexample_tpu.data.pipeline import Batcher
+
+    rng = np.random.RandomState(6)
+    images = rng.randn(300, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, size=300).astype(np.int32)
+    b1 = Batcher(images, labels, 64, seed=9)
+    b2 = Batcher(images, labels, 64, seed=9)
+    import distributedtensorflowexample_tpu.native.loader as loader
+    batch_native = next(b1)
+    saved = loader._LIB
+    loader._LIB, loader._FAILED = None, True    # force numpy fallback
+    try:
+        batch_numpy = next(b2)
+    finally:
+        loader._LIB, loader._FAILED = saved, False
+    np.testing.assert_array_equal(batch_native["image"], batch_numpy["image"])
+    np.testing.assert_array_equal(batch_native["label"], batch_numpy["label"])
+
+
+def test_batcher_fused_augment_parity():
+    """CIFAR Batcher with augmentation: the fused native gather+augment
+    yields bit-identical batches to the numpy gather-then-augment path."""
+    from distributedtensorflowexample_tpu.data.cifar10 import augment
+    from distributedtensorflowexample_tpu.data.pipeline import Batcher
+
+    rng = np.random.RandomState(7)
+    images = rng.randn(300, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=300).astype(np.int32)
+    b1 = Batcher(images, labels, 64, seed=11, augment_fn=augment)
+    b2 = Batcher(images, labels, 64, seed=11, augment_fn=augment)
+    import distributedtensorflowexample_tpu.native.loader as loader
+    batch_native = next(b1)
+    saved = loader._LIB
+    loader._LIB, loader._FAILED = None, True    # force numpy fallback
+    try:
+        batch_numpy = next(b2)
+    finally:
+        loader._LIB, loader._FAILED = saved, False
+    np.testing.assert_array_equal(batch_native["image"], batch_numpy["image"])
+    np.testing.assert_array_equal(batch_native["label"], batch_numpy["label"])
