@@ -74,6 +74,16 @@ class RunConfig:
     pallas_ce: bool = False         # fused Pallas loss head in the train step
     fused_optimizer: bool = False   # fused Pallas momentum-SGD apply
 
+    # --- input pipeline ---
+    device_data: str = "auto"       # auto | on | off — dataset resident in
+                                    # HBM with on-device batch gather (kills
+                                    # the per-step H2D copy; auto = sync
+                                    # mode without augmentation)
+    steps_per_loop: int = 1         # SGD steps fused into one compiled call
+                                    # (lax.scan); device_data path only.
+                                    # Amortizes dispatch latency like Keras
+                                    # steps_per_execution
+
     @property
     def ps_host_list(self) -> list[str]:
         return [h for h in self.ps_hosts.split(",") if h]

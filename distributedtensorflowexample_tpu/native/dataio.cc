@@ -125,15 +125,16 @@ void gather_i32(const int32_t* src, const int64_t* idx, int64_t batch,
 
 // ---- CIFAR train augmentation --------------------------------------------
 
-// Random crop from a reflect-padded (pad=4) image + horizontal flip,
-// fused: the padded image is never materialized.  src/out are
-// [batch, h, w, c] f32; ys/xs in [0, 8], flips in {0, 1}.
-void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
-                       int64_t c, const int32_t* ys, const int32_t* xs,
-                       const uint8_t* flips, float* out) {
+namespace {
+
+// One implementation of the crop/flip indexing for both entry points:
+// idx == nullptr means identity (output row i sources input row i).
+void crop_flip_impl(const float* src, const int64_t* idx, int64_t batch,
+                    int64_t h, int64_t w, int64_t c, const int32_t* ys,
+                    const int32_t* xs, const uint8_t* flips, float* out) {
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < batch; ++i) {
-    const float* img = src + i * h * w * c;
+    const float* img = src + (idx ? idx[i] : i) * h * w * c;
     float* dst = out + i * h * w * c;
     const int64_t y0 = ys[i], x0 = xs[i];
     const bool flip = flips[i] != 0;
@@ -150,29 +151,24 @@ void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
   }
 }
 
+}  // namespace
+
+// Random crop from a reflect-padded (pad=4) image + horizontal flip,
+// fused: the padded image is never materialized.  src/out are
+// [batch, h, w, c] f32; ys/xs in [0, 8], flips in {0, 1}.
+void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
+                       int64_t c, const int32_t* ys, const int32_t* xs,
+                       const uint8_t* flips, float* out) {
+  crop_flip_impl(src, nullptr, batch, h, w, c, ys, xs, flips, out);
+}
+
 // Gather + augment in one pass: rows are pulled from the full training
 // array and augmented straight into the output batch (no intermediate
 // batch copy).
 void gather_augment_f32(const float* src, const int64_t* idx, int64_t batch,
                         int64_t h, int64_t w, int64_t c, const int32_t* ys,
                         const int32_t* xs, const uint8_t* flips, float* out) {
-#pragma omp parallel for schedule(static)
-  for (int64_t i = 0; i < batch; ++i) {
-    const float* img = src + idx[i] * h * w * c;
-    float* dst = out + i * h * w * c;
-    const int64_t y0 = ys[i], x0 = xs[i];
-    const bool flip = flips[i] != 0;
-    for (int64_t y = 0; y < h; ++y) {
-      const int64_t sy = reflect4(y0 + y, h);
-      for (int64_t x = 0; x < w; ++x) {
-        const int64_t ox = flip ? (w - 1 - x) : x;
-        const int64_t sx = reflect4(x0 + ox, w);
-        const float* s = img + (sy * w + sx) * c;
-        float* d = dst + (y * w + x) * c;
-        for (int64_t ch = 0; ch < c; ++ch) d[ch] = s[ch];
-      }
-    }
-  }
+  crop_flip_impl(src, idx, batch, h, w, c, ys, xs, flips, out);
 }
 
 int omp_max_threads() {

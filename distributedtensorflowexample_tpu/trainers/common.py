@@ -17,7 +17,7 @@ import numpy as np
 from distributedtensorflowexample_tpu import cluster
 from distributedtensorflowexample_tpu.config import RunConfig
 from distributedtensorflowexample_tpu.data import (
-    Batcher, DevicePrefetcher, load_cifar10, load_mnist)
+    Batcher, DeviceDataset, DevicePrefetcher, load_cifar10, load_mnist)
 from distributedtensorflowexample_tpu.data.cifar10 import augment as cifar_augment
 from distributedtensorflowexample_tpu.models import build_model
 from distributedtensorflowexample_tpu.parallel import (
@@ -25,7 +25,7 @@ from distributedtensorflowexample_tpu.parallel import (
 from distributedtensorflowexample_tpu.parallel.async_ps import (
     consolidate, make_async_train_step, make_worker_state)
 from distributedtensorflowexample_tpu.parallel.sync import (
-    evaluate, make_train_step)
+    evaluate, make_indexed_train_step, make_train_step)
 from distributedtensorflowexample_tpu.training.checkpoint import CheckpointManager
 from distributedtensorflowexample_tpu.training.hooks import (
     CheckpointHook, EvalHook)
@@ -69,13 +69,27 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     train_x, train_y = _load_dataset(cfg, dataset_name, "train")
     test_x, test_y = _load_dataset(cfg, dataset_name, "test")
-    batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
-                      process_index=jax.process_index(),
-                      process_count=jax.process_count(),
-                      augment_fn=cifar_augment if augment else None)
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
-    batches = DevicePrefetcher(batcher, sharding=data_shard)
+
+    # Device-resident input path (data/device_dataset.py): the split lives
+    # in HBM and batches are gathered on device — no per-step H2D copy.
+    # "auto" uses it whenever the step can consume it (sync mode; the host
+    # augmentation pipeline needs the host path).
+    if cfg.device_data not in ("auto", "on", "off"):
+        raise ValueError(f"unknown device_data {cfg.device_data!r}")
+    if cfg.device_data == "on" and (augment or cfg.sync_mode == "async"):
+        raise ValueError("--device_data=on requires sync mode without "
+                         "augmentation (use off/auto)")
+    use_device_data = (cfg.device_data == "on"
+                       or (cfg.device_data == "auto" and not augment
+                           and cfg.sync_mode == "sync"))
+    if not use_device_data:
+        batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
+                          process_index=jax.process_index(),
+                          process_count=jax.process_count(),
+                          augment_fn=cifar_augment if augment else None)
+        batches = DevicePrefetcher(batcher, sharding=data_shard)
 
     model = build_model(model_name, dropout=cfg.dropout,
                         dtype=jnp.dtype(cfg.dtype))
@@ -121,12 +135,42 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                                   cfg.profile_num_steps))
 
     ce_impl = "pallas" if cfg.pallas_ce else "xla"
-    train_step = (make_async_train_step(num_replicas, cfg.async_period,
-                                        cfg.label_smoothing)
-                  if is_async else make_train_step(cfg.label_smoothing,
-                                                   ce_impl=ce_impl, mesh=mesh))
+    steps_per_call = 1
+    if is_async:
+        train_step = make_async_train_step(num_replicas, cfg.async_period,
+                                           cfg.label_smoothing)
+    elif use_device_data:
+        steps_per_call = max(1, cfg.steps_per_loop)
+        if cfg.train_steps % steps_per_call:
+            raise ValueError(
+                f"--train_steps {cfg.train_steps} must be a multiple of "
+                f"--steps_per_loop {steps_per_call}")
+        if int(state.step) % steps_per_call:
+            # An unaligned resume would drop tail steps AND let a scan
+            # window straddle an epoch boundary (DeviceDataset only swaps
+            # the permutation between calls).
+            raise ValueError(
+                f"resumed step {int(state.step)} is not a multiple of "
+                f"--steps_per_loop {steps_per_call}; resume with the "
+                f"steps_per_loop the checkpoint was written under")
+        # Constructed after a possible resume so epoch boundaries line up
+        # with the restored global step.
+        ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh,
+                           seed=cfg.seed, start_step=int(state.step),
+                           steps_per_next=steps_per_call)
+        batches = ds
+        train_step = make_indexed_train_step(
+            global_batch, ds.steps_per_epoch, cfg.label_smoothing,
+            ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call)
+    else:
+        if cfg.steps_per_loop > 1:
+            raise ValueError("--steps_per_loop > 1 requires the "
+                             "device-resident input path (device_data)")
+        train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
+                                     mesh=mesh)
     with mesh:
-        loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger)
+        loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger,
+                         steps_per_call=steps_per_call)
         state = loop.run(state)
         final_acc = eval_fn(state)
 

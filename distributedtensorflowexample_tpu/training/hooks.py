@@ -30,15 +30,34 @@ class StopAtStepHook(Hook):
         return step >= self._last_step
 
 
+class _EveryN:
+    """Boundary-crossing interval check: fires when the step counter reaches
+    or jumps past the next multiple of ``every`` — correct both for stride-1
+    loops and multi-step train calls that advance several steps per call."""
+
+    def __init__(self, every: int, start: int = 0):
+        self._every = every
+        self._next = None if not every else (start // every + 1) * every
+
+    def __call__(self, step: int) -> bool:
+        if self._next is None or step < self._next:
+            return False
+        self._next = (step // self._every + 1) * self._every
+        return True
+
+
 class CheckpointHook(Hook):
     """Periodic + final checkpoint via the Orbax-backed manager."""
 
     def __init__(self, manager, every: int):
         self._manager = manager
-        self._every = every
+        self._due = _EveryN(every)
+
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._due._every, int(loop.start_step))
 
     def after_step(self, step, state, metrics) -> bool:
-        if self._every and step % self._every == 0:
+        if self._due(step):
             self._manager.save(step, state)
         return False
 
@@ -52,10 +71,13 @@ class EvalHook(Hook):
 
     def __init__(self, eval_fn, every: int, logger):
         self._eval_fn = eval_fn
-        self._every = every
+        self._due = _EveryN(every)
         self._logger = logger
 
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._due._every, int(loop.start_step))
+
     def after_step(self, step, state, metrics) -> bool:
-        if self._every and step % self._every == 0:
+        if self._due(step):
             self._logger.scalar(step, "eval_accuracy", self._eval_fn(state))
         return False

@@ -20,20 +20,27 @@ from distributedtensorflowexample_tpu.training.state import TrainState
 
 class TrainLoop:
     def __init__(self, train_step, batches: Iterator, num_steps: int,
-                 hooks: Iterable[Hook] = (), logger: MetricsLogger | None = None):
+                 hooks: Iterable[Hook] = (), logger: MetricsLogger | None = None,
+                 steps_per_call: int = 1):
+        """``steps_per_call``: global steps one train_step call advances
+        (the indexed step's ``unroll_steps``).  Hooks fire at call
+        boundaries; interval hooks handle strides that jump their mark."""
         self._train_step = train_step
         self._batches = batches
         self._num_steps = num_steps
         self._hooks = list(hooks)
         self._logger = logger or MetricsLogger()
+        self._spc = max(1, steps_per_call)
+        self.start_step = 0
 
     def run(self, state: TrainState) -> TrainState:
         start = int(state.step)
+        self.start_step = start
         for h in self._hooks:
             h.begin(self)
         self._logger.start(start)
         metrics = None
-        for step in range(start + 1, self._num_steps + 1):
+        for step in range(start + self._spc, self._num_steps + 1, self._spc):
             state, metrics = self._train_step(state, next(self._batches))
             self._logger.maybe_log(step, metrics)
             # Every hook sees every step (no short-circuit) — a stop request

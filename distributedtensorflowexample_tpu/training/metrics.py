@@ -36,7 +36,10 @@ class MetricsLogger:
         self._last_time = time.perf_counter()
 
     def maybe_log(self, step: int, metrics) -> None:
-        if step % self._log_every:
+        # Boundary-crossing check (not a modulo): with a multi-step train
+        # call the step counter advances in strides, and a stride that
+        # jumps over a multiple of log_every must still log.
+        if step < self._last_step + self._log_every:
             return
         # Block on the metric values only here, at the log boundary.
         fetched = {k: float(v) for k, v in

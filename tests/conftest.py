@@ -14,6 +14,12 @@ import pytest
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Synchronous CPU dispatch: with 8 virtual devices on few cores, a deep
+# async queue of collective programs can deadlock XLA:CPU's rendezvous
+# (observed with the zero-host-work device-resident input path, which lets
+# the queue grow unboundedly).  Purely a test-environment knob — the TPU
+# runtime throttles its own queue.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
 @pytest.fixture()

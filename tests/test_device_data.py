@@ -1,0 +1,206 @@
+"""Device-resident input path (data/device_dataset.py + indexed step).
+
+Checks the semantics the host Batcher guarantees — shuffled epochs without
+replacement, deterministic resume alignment — carry over to the on-device
+gather path, on the 8-virtual-device mesh (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflowexample_tpu.data import DeviceDataset
+from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.parallel import (
+    make_mesh, replicated_sharding)
+from distributedtensorflowexample_tpu.parallel.sync import (
+    make_indexed_train_step, make_train_step)
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+
+def _data(n=520, shape=(28, 28, 1)):
+    return make_synthetic(n, shape, 10, seed=0)
+
+
+def test_epoch_is_permutation_without_replacement():
+    x, y = _data()
+    mesh = make_mesh()
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=3)
+    assert ds.steps_per_epoch == 520 // 64
+    data = next(ds)
+    perm = np.asarray(data["perm"])
+    assert len(perm) == ds.epoch_len
+    assert len(np.unique(perm)) == ds.epoch_len        # no replacement
+    # Perm persists within the epoch, changes at the boundary.
+    for _ in range(ds.steps_per_epoch - 1):
+        np.testing.assert_array_equal(np.asarray(next(ds)["perm"]), perm)
+    perm2 = np.asarray(next(ds)["perm"])
+    assert not np.array_equal(perm2, perm)
+    assert len(np.unique(perm2)) == ds.epoch_len
+
+
+def test_start_step_alignment_matches_fresh_run():
+    """A dataset started at step k yields the same perm schedule a fresh
+    dataset reaches after k nexts — resume determinism."""
+    x, y = _data()
+    mesh = make_mesh()
+    k = 11
+    fresh = DeviceDataset(x, y, 64, mesh=mesh, seed=5)
+    for _ in range(k):
+        next(fresh)
+    resumed = DeviceDataset(x, y, 64, mesh=mesh, seed=5, start_step=k)
+    for _ in range(5):
+        np.testing.assert_array_equal(np.asarray(next(fresh)["perm"]),
+                                      np.asarray(next(resumed)["perm"]))
+
+
+def test_indexed_step_consumes_each_epoch_row_once():
+    """One epoch of the position arithmetic covers every dataset row once;
+    a real step execution is cross-checked against the host-gathered batch
+    in test_indexed_step_gather_matches_host_batch."""
+    n, b = 256, 32
+    x = np.zeros((n, 8, 8, 1), np.float32)
+    y = np.arange(n, dtype=np.int32)        # label == row id
+    mesh = make_mesh()
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=7)
+
+    seen = []
+    for i in range(ds.steps_per_epoch):
+        data = next(ds)
+        pos = (i % ds.steps_per_epoch) * b
+        idx = np.asarray(data["perm"])[pos:pos + b]
+        seen.extend(np.asarray(y)[idx].tolist())
+    assert sorted(seen) == list(range(n))
+
+
+def test_indexed_step_gather_matches_host_batch():
+    """The device gather feeds the step the exact rows the perm arithmetic
+    names: an indexed step and a plain step fed the manually-gathered batch
+    produce identical params from identical initial state."""
+    mesh = make_mesh()
+    x, y = _data(256)
+    b = 64
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=4)
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.2), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    s_idx, s_ref = make_state(), make_state()
+    data = next(ds)
+    perm = np.asarray(data["perm"])
+    host_batch = {"image": jnp.asarray(x[perm[:b]]),
+                  "label": jnp.asarray(y[perm[:b]])}
+    with mesh:
+        s_idx, m_idx = make_indexed_train_step(b, ds.steps_per_epoch)(
+            s_idx, data)
+        s_ref, m_ref = make_train_step()(s_ref, host_batch)
+    np.testing.assert_allclose(float(m_idx["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s_idx.params, s_ref.params)
+
+
+def test_indexed_step_trains_on_mesh():
+    mesh = make_mesh()
+    x, y = _data(512)
+    b = 64
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=0)
+    state = TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.5), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    step = make_indexed_train_step(b, ds.steps_per_epoch, mesh=mesh)
+    losses = []
+    with mesh:
+        for _ in range(30):
+            state, m = step(state, next(ds))
+            losses.append(float(m["loss"]))
+    assert int(state.step) == 30
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    # Params stay replicated; the gathered batch resharding is internal.
+    assert jax.tree.leaves(state.params)[0].sharding.is_fully_replicated
+
+
+def test_device_data_flag_validation(tmp_path, small_synthetic):
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    cfg = RunConfig(device_data="on", sync_mode="async", train_steps=1,
+                    batch_size=64, global_batch=True,
+                    data_dir=str(tmp_path), log_dir=str(tmp_path / "l"),
+                    resume=False)
+    with pytest.raises(ValueError, match="device_data"):
+        run_training(cfg, "softmax", "mnist")
+
+
+@pytest.fixture()
+def small_synthetic(monkeypatch):
+    """Shrink the synthetic fallback splits: the device-resident path
+    replicates the whole split per virtual device, and full-size programs
+    on the 1-core CI host stretch XLA:CPU's 8-thread collective rendezvous
+    past its hard timeout (flaky aborts).  Semantics under test don't
+    depend on split size."""
+    from distributedtensorflowexample_tpu.data import mnist
+    monkeypatch.setattr(mnist, "_SYNTH_SIZES", {"train": 2048, "test": 512})
+
+
+def test_run_training_device_data_end_to_end(tmp_path, small_synthetic):
+    """run_training on the auto (device-resident) path: trains, evals,
+    checkpoints, and resumes with aligned epochs."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  dataset="mnist", log_every=50, seed=1)
+    out = run_training(RunConfig(train_steps=60, checkpoint_every=50,
+                                 resume=False, **common), "softmax", "mnist")
+    assert out["steps"] == 60
+    assert out["final_accuracy"] > 0.8
+    out2 = run_training(RunConfig(train_steps=80, resume=True, **common),
+                        "softmax", "mnist")
+    assert out2["steps"] == 80
+
+
+def test_unrolled_step_matches_stepwise():
+    """K fused updates == K separate updates, bit-for-bit on params."""
+    mesh = make_mesh()
+    x, y = _data(512)
+    b, K = 64, 4
+    mk = lambda spn: DeviceDataset(x, y, b, mesh=mesh, seed=2,
+                                   steps_per_next=spn)
+    state_kw = dict()
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+
+    ds1, dsK = mk(1), mk(K)
+    assert ds1.steps_per_epoch == dsK.steps_per_epoch  # 512//64=8, K|8
+    s1, sK = make_state(), make_state()
+    one = make_indexed_train_step(b, ds1.steps_per_epoch)
+    fused = make_indexed_train_step(b, dsK.steps_per_epoch, unroll_steps=K)
+    with mesh:
+        for _ in range(2 * K):
+            s1, m1 = one(s1, next(ds1))
+        sK, mK = fused(sK, next(dsK))
+        sK, mK = fused(sK, next(dsK))
+    assert int(s1.step) == int(sK.step) == 2 * K
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s1.params, sK.params)
+
+
+def test_run_training_steps_per_loop(tmp_path, small_synthetic):
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  dataset="mnist", log_every=20, seed=1, resume=False)
+    out = run_training(RunConfig(train_steps=60, steps_per_loop=4, **common),
+                       "softmax", "mnist")
+    assert out["steps"] == 60
+    assert out["final_accuracy"] > 0.8
+    with pytest.raises(ValueError, match="multiple"):
+        run_training(RunConfig(train_steps=61, steps_per_loop=4, **common),
+                     "softmax", "mnist")
