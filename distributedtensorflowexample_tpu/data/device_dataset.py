@@ -36,39 +36,52 @@ class DeviceDataset:
     so epoch boundaries line up with the step's position arithmetic.
     """
 
+    # Epochs are truncated to a multiple of this power of two (capped by
+    # dataset size), and steps_per_next must divide it.  This makes the
+    # epoch schedule a function of (dataset, batch) ONLY — changing
+    # steps_per_loop between runs or across a resume cannot silently
+    # remap which permutation/position a given global step sees.
+    EPOCH_MULTIPLE_CAP = 32
+
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
                  shuffle: bool = True, start_step: int = 0,
                  steps_per_next: int = 1):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
         the train step's ``unroll_steps`` so the permutation swaps on the
-        right call; the epoch is truncated to a multiple of it (a scan
-        window never crosses an epoch boundary)."""
-        if len(images) < batch_size * steps_per_next:
+        right call.  Must be a power of two dividing the epoch multiple
+        (a scan window never crosses an epoch boundary)."""
+        if len(images) < batch_size:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than "
-                f"batch {batch_size} x unroll {steps_per_next}")
+                f"batch {batch_size}")
         self._n = len(images)
-        self._batch = batch_size
-        self.steps_per_epoch = ((self._n // batch_size) // steps_per_next
-                                * steps_per_next)
+        raw_steps = self._n // batch_size
+        multiple = 1
+        while multiple * 2 <= min(self.EPOCH_MULTIPLE_CAP, raw_steps):
+            multiple *= 2
+        if steps_per_next < 1 or multiple % steps_per_next:
+            raise ValueError(
+                f"steps_per_next {steps_per_next} must be a power of two "
+                f"dividing {multiple} (epoch multiple for {self._n} "
+                f"examples at batch {batch_size})")
+        self.steps_per_epoch = (raw_steps // multiple) * multiple
         self.epoch_len = self.steps_per_epoch * batch_size
         self._spn = steps_per_next
-        self._shuffle = shuffle
         self._step = int(start_step)
         self._epoch = None
         self._perm = None
 
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            repl = NamedSharding(mesh, P())
+            from distributedtensorflowexample_tpu.parallel.mesh import (
+                replicated_sharding)
+            repl = replicated_sharding(mesh)
             if jax.process_count() > 1:
                 put = lambda x: jax.make_array_from_process_local_data(repl, x)
             else:
                 put = lambda x: jax.device_put(x, repl)
         else:
             repl, put = None, jax.device_put
-        self._repl = repl
         self.images = put(np.ascontiguousarray(images))
         self.labels = put(np.ascontiguousarray(labels))
 

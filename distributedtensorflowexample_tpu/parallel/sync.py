@@ -146,9 +146,10 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
             # every device; the constraint re-shards the minibatch along
             # the batch axis (slice-keeping, no collective) so the rest of
             # the step runs data-parallel exactly like the host-fed path.
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            shard = NamedSharding(mesh, P(DATA_AXIS))
-            batch = jax.lax.with_sharding_constraint(batch, shard)
+            from distributedtensorflowexample_tpu.parallel.mesh import (
+                batch_sharding)
+            batch = jax.lax.with_sharding_constraint(batch,
+                                                     batch_sharding(mesh))
         return inner(state, batch)
 
     if unroll_steps == 1:

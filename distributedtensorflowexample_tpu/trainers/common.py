@@ -137,6 +137,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     ce_impl = "pallas" if cfg.pallas_ce else "xla"
     steps_per_call = 1
     if is_async:
+        if cfg.steps_per_loop > 1:
+            raise ValueError("--steps_per_loop > 1 is not supported with "
+                             "sync_mode=async")
         train_step = make_async_train_step(num_replicas, cfg.async_period,
                                            cfg.label_smoothing)
     elif use_device_data:

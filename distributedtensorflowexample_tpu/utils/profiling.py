@@ -32,9 +32,12 @@ def trace_context(logdir: str):
 class ProfilerHook(Hook):
     """Trace a window of live training steps.
 
-    Starts capture after step ``start_step`` completes and stops once
-    ``num_steps`` further steps have run, so the window contains exactly the
+    Starts capture after step ``start_step`` completes and stops once at
+    least ``num_steps`` further steps have run, so the window contains only
     steady-state steps (never compilation, provided ``start_step`` > 0).
+    The hook sees the loop at call boundaries: with a multi-step train call
+    (``steps_per_loop`` K) the window rounds up to whole calls, capturing
+    up to K-1 extra steps.
     Chief-only by construction on multi-host: every process traces its own
     devices into a per-process subdirectory, matching ``jax.profiler``
     multi-host semantics.

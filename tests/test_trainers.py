@@ -44,3 +44,18 @@ def test_mirrored_resnet_smoke(tmp_log_dir):
                       "--warmup_steps", "2"]))
     assert summary["steps"] == 10
     assert np.isfinite(summary["final_accuracy"])
+
+
+def test_multiworker_trainer_single_process(tmp_log_dir):
+    """Config 5 entrypoint degenerates correctly to one process (the same
+    SPMD program; the mesh simply spans one host's devices)."""
+    from distributedtensorflowexample_tpu.trainers import (
+        trainer_multiworker_cifar)
+
+    summary = trainer_multiworker_cifar.main(_common_flags(
+        tmp_log_dir, ["--train_steps", "6", "--batch_size", "8",
+                      "--num_processes", "1", "--warmup_steps", "2",
+                      "--log_every", "3"]))
+    assert summary["steps"] == 6
+    assert summary["num_replicas"] == 8
+    assert np.isfinite(summary["final_accuracy"])
