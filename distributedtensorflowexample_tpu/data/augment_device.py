@@ -1,0 +1,39 @@
+"""On-device CIFAR augmentation (random reflect-pad-4 crop + hflip).
+
+The host pipeline augments with numpy/C++ (``cifar10.augment``); this is
+the same transform expressed as jnp for use INSIDE the jitted train step,
+so the device-resident input path (``DeviceDataset`` +
+``make_indexed_train_step``) covers the augmented CIFAR workloads too —
+batches never touch the host.  Same distribution as the host path (crop
+offsets uniform on [0, 8], flip probability 1/2, reflect padding), but a
+different RNG stream (``jax.random`` vs the host ``RandomState``), so a
+device-augmented run is deterministic per seed yet not bit-identical to a
+host-augmented run.
+
+All shapes are static: pad → per-image ``dynamic_slice`` under ``vmap`` →
+masked flip.  XLA fuses the whole thing into the step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+PAD = 4
+
+
+def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """[B, H, W, C] float32 → same shape, randomly cropped + flipped."""
+    b, h, w, c = images.shape
+    ky, kx, kf = jax.random.split(key, 3)
+    ys = jax.random.randint(ky, (b,), 0, 2 * PAD + 1)
+    xs = jax.random.randint(kx, (b,), 0, 2 * PAD + 1)
+    flips = jax.random.bernoulli(kf, 0.5, (b,))
+    padded = jnp.pad(images, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)),
+                     mode="reflect")
+
+    def crop(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    crops = jax.vmap(crop)(padded, ys, xs)
+    return jnp.where(flips[:, None, None, None], crops[:, :, ::-1, :], crops)

@@ -36,12 +36,22 @@ class DeviceDataset:
     so epoch boundaries line up with the step's position arithmetic.
     """
 
-    # Epochs are truncated to a multiple of this power of two (capped by
-    # dataset size), and steps_per_next must divide it.  This makes the
-    # epoch schedule a function of (dataset, batch) ONLY — changing
-    # steps_per_loop between runs or across a resume cannot silently
-    # remap which permutation/position a given global step sees.
+    # Epochs are truncated to a multiple of a power-of-two granule derived
+    # from (dataset size, batch) ONLY — never from steps_per_next — so
+    # changing steps_per_loop between runs or across a resume cannot
+    # silently remap which permutation/position a given global step sees.
+    # The granule is the largest power of two ≤ the cap whose truncation
+    # drops at most 1/16 of the epoch's batches.
     EPOCH_MULTIPLE_CAP = 32
+
+    @classmethod
+    def epoch_multiple(cls, raw_steps: int) -> int:
+        m = 1
+        while m * 2 <= min(cls.EPOCH_MULTIPLE_CAP, raw_steps):
+            m *= 2
+        while m > 1 and (raw_steps % m) * 16 > raw_steps:
+            m //= 2
+        return m
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
@@ -57,9 +67,7 @@ class DeviceDataset:
                 f"batch {batch_size}")
         self._n = len(images)
         raw_steps = self._n // batch_size
-        multiple = 1
-        while multiple * 2 <= min(self.EPOCH_MULTIPLE_CAP, raw_steps):
-            multiple *= 2
+        multiple = self.epoch_multiple(raw_steps)
         if steps_per_next < 1 or multiple % steps_per_next:
             raise ValueError(
                 f"steps_per_next {steps_per_next} must be a power of two "
@@ -67,6 +75,12 @@ class DeviceDataset:
                 f"examples at batch {batch_size})")
         self.steps_per_epoch = (raw_steps // multiple) * multiple
         self.epoch_len = self.steps_per_epoch * batch_size
+        if not shuffle and self.steps_per_epoch < raw_steps:
+            import warnings
+            warnings.warn(
+                f"shuffle=False with epoch truncated from {raw_steps} to "
+                f"{self.steps_per_epoch} steps: the last "
+                f"{self._n - self.epoch_len} examples will never be seen")
         self._spn = steps_per_next
         self._step = int(start_step)
         self._epoch = None

@@ -100,7 +100,8 @@ def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
 def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             label_smoothing: float = 0.0,
                             ce_impl: str = "xla", mesh=None,
-                            unroll_steps: int = 1) -> Callable:
+                            unroll_steps: int = 1,
+                            augment: str = "none") -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -126,12 +127,16 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     boundary (the host swaps the permutation between calls); returned
     metrics are the mean over the K updates.
     """
-    if unroll_steps < 1:
-        raise ValueError(f"unroll_steps must be >= 1, got {unroll_steps}")
+    if unroll_steps < 1 or (unroll_steps & (unroll_steps - 1)):
+        raise ValueError(
+            f"unroll_steps must be a power of two >= 1, got {unroll_steps}")
     if steps_per_epoch % unroll_steps:
         raise ValueError(
             f"unroll_steps {unroll_steps} must divide steps_per_epoch "
-            f"{steps_per_epoch} (see DeviceDataset round_to)")
+            f"{steps_per_epoch} — pass the same value as DeviceDataset's "
+            f"steps_per_next (see DeviceDataset.epoch_multiple)")
+    if augment not in ("none", "cifar"):
+        raise ValueError(f"unknown augment {augment!r}")
     inner = _build_step_fn(label_smoothing, ce_impl, mesh)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
@@ -141,6 +146,15 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
         idx = jax.lax.dynamic_slice(data["perm"], (pos,), (batch_size,))
         batch = {"image": jnp.take(data["images"], idx, axis=0),
                  "label": jnp.take(data["labels"], idx, axis=0)}
+        if augment == "cifar":
+            # On-device crop/flip (data/augment_device.py): a dedicated
+            # stream folded from the state rng — disjoint from the
+            # dropout stream, which folds in only the step.
+            from distributedtensorflowexample_tpu.data.augment_device import (
+                cifar_augment_device)
+            akey = jax.random.fold_in(
+                jax.random.fold_in(state.rng, 0x5EED), state.step)
+            batch["image"] = cifar_augment_device(batch["image"], akey)
         if mesh is not None and mesh.size > 1:
             # Dataset + perm are replicated, so the gather is local on
             # every device; the constraint re-shards the minibatch along

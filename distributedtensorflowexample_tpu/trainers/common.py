@@ -74,15 +74,14 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     # Device-resident input path (data/device_dataset.py): the split lives
     # in HBM and batches are gathered on device — no per-step H2D copy.
-    # "auto" uses it whenever the step can consume it (sync mode; the host
-    # augmentation pipeline needs the host path).
+    # "auto" uses it whenever the step can consume it (sync mode;
+    # augmentation runs on device, data/augment_device.py).
     if cfg.device_data not in ("auto", "on", "off"):
         raise ValueError(f"unknown device_data {cfg.device_data!r}")
-    if cfg.device_data == "on" and (augment or cfg.sync_mode == "async"):
-        raise ValueError("--device_data=on requires sync mode without "
-                         "augmentation (use off/auto)")
+    if cfg.device_data == "on" and cfg.sync_mode == "async":
+        raise ValueError("--device_data=on requires sync mode (use off/auto)")
     use_device_data = (cfg.device_data == "on"
-                       or (cfg.device_data == "auto" and not augment
+                       or (cfg.device_data == "auto"
                            and cfg.sync_mode == "sync"))
     if not use_device_data:
         batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
@@ -164,7 +163,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         batches = ds
         train_step = make_indexed_train_step(
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
-            ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call)
+            ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call,
+            augment="cifar" if augment else "none")
     else:
         if cfg.steps_per_loop > 1:
             raise ValueError("--steps_per_loop > 1 requires the "

@@ -9,16 +9,28 @@ overrides JAX_PLATFORMS from the environment, so the env-var route does not
 work here — the config must be updated in-process before first backend use.
 """
 
+import os
+
+# In-process CPU collectives need every virtual device's thread in flight
+# at once; on this 1-core host a starved thread can miss XLA's default
+# 40-second rendezvous deadline, which ABORTS the process (rendezvous.cc
+# "Expected 8 threads to join... only 7 arrived").  Raise the deadline so
+# starvation waits instead of killing the test run.  Must be in XLA_FLAGS
+# before first backend use.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+
 import jax
 import pytest
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
-# Synchronous CPU dispatch: with 8 virtual devices on few cores, a deep
-# async queue of collective programs can deadlock XLA:CPU's rendezvous
-# (observed with the zero-host-work device-resident input path, which lets
-# the queue grow unboundedly).  Purely a test-environment knob — the TPU
-# runtime throttles its own queue.
+# Synchronous CPU dispatch: a deep async queue of collective programs
+# multiplies the concurrent-thread demand and with it the starvation
+# window.  Purely a test-environment knob — the TPU runtime throttles its
+# own queue.
 jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 

@@ -204,3 +204,24 @@ def test_run_training_steps_per_loop(tmp_path, small_synthetic):
     with pytest.raises(ValueError, match="multiple"):
         run_training(RunConfig(train_steps=61, steps_per_loop=4, **common),
                      "softmax", "mnist")
+
+
+def test_epoch_multiple_bounds_drop():
+    """The truncation granule is spn-independent, a power of two, and never
+    drops more than 1/16 of an epoch's batches."""
+    for raw in (1, 4, 8, 9, 31, 33, 48, 63, 71, 234, 937, 4096):
+        m = DeviceDataset.epoch_multiple(raw)
+        assert m & (m - 1) == 0 and 1 <= m <= 32
+        dropped = raw % m
+        assert dropped * 16 <= raw, (raw, m, dropped)
+    # The review's worst case: 48 raw steps must not truncate to 32.
+    assert DeviceDataset.epoch_multiple(48) == 16
+
+
+def test_unshuffled_truncation_warns():
+    # raw 33 steps at batch 64: granule 32 (drop 1/33 ≤ 1/16), so one step
+    # is truncated — with shuffle=False those rows are never visited.
+    x, y = _data(n=33 * 64)
+    mesh = make_mesh()
+    with pytest.warns(UserWarning, match="never be seen"):
+        DeviceDataset(x, y, 64, mesh=mesh, shuffle=False)
