@@ -9,6 +9,7 @@ waits on the host at MNIST-scale step times.
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, Iterator
 
 import jax
@@ -44,8 +45,12 @@ class TrainLoop:
             state, metrics = self._train_step(state, next(self._batches))
             self._logger.maybe_log(step, metrics)
             # Every hook sees every step (no short-circuit) — a stop request
-            # must not mask another hook's work at the same step.
+            # must not mask another hook's work at the same step.  Hook wall
+            # time (eval, checkpoint serialization) is discounted from the
+            # throughput window so steps_per_sec stays a training rate.
+            t_hooks = time.perf_counter()
             stops = [h.after_step(step, state, metrics) for h in self._hooks]
+            self._logger.exclude(time.perf_counter() - t_hooks)
             if any(stops):
                 break
         # Drain outstanding device work so end-hooks (checkpoint) see final

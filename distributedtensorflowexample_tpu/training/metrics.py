@@ -3,8 +3,13 @@
 steps/sec/chip is THE headline metric (BASELINE.json "metric"), so the loop
 owns its measurement: wall time between flushes, device arrays fetched only
 at log boundaries (never per step — that would serialize host and device),
-scalars mirrored to stdout (the reference's UX) and a JSONL scalar log (the
-``tf.summary`` replacement, greppable and TensorBoard-convertible).
+scalars mirrored to stdout (the reference's UX), a JSONL scalar log
+(greppable), and a native TensorBoard tfevents file (utils/tfevents.py —
+the ``tf.summary`` replacement; ``tensorboard --logdir`` works directly).
+
+Throughput windows are honest: the loop reports hook execution time
+(eval/checkpoint wall time) via :meth:`exclude`, so ``steps_per_sec``
+measures training, not whatever ran between flushes.
 """
 
 from __future__ import annotations
@@ -25,15 +30,25 @@ class MetricsLogger:
         self._last_time = None
         self._last_step = 0
         self._file = None
+        self._events = None
         if log_dir and is_chief:
             os.makedirs(log_dir, exist_ok=True)
             self._file = open(os.path.join(log_dir, "scalars.jsonl"), "a",
                               buffering=1)
+            from distributedtensorflowexample_tpu.utils.tfevents import (
+                TFEventsWriter)
+            self._events = TFEventsWriter(log_dir)
         self.last_steps_per_sec = 0.0
 
     def start(self, step: int):
         self._last_step = step
         self._last_time = time.perf_counter()
+
+    def exclude(self, seconds: float) -> None:
+        """Discount ``seconds`` of non-training wall time (hook execution)
+        from the current throughput window."""
+        if self._last_time is not None:
+            self._last_time += seconds
 
     def maybe_log(self, step: int, metrics) -> None:
         # Boundary-crossing check (not a modulo): with a multi-step train
@@ -46,11 +61,16 @@ class MetricsLogger:
                    jax.device_get(metrics).items()}
         now = time.perf_counter()
         if self._last_time is not None and step > self._last_step:
+            # dt can only be non-positive if exclude() over-discounted (a
+            # hook outlived the window); skip the rate rather than report
+            # a negative or bogus one.
             dt = now - self._last_time
-            sps = (step - self._last_step) / dt
-            self.last_steps_per_sec = sps
-            fetched["steps_per_sec"] = round(sps, 2)
-            fetched["steps_per_sec_per_chip"] = round(sps / self._num_chips, 2)
+            if dt > 0:
+                sps = (step - self._last_step) / dt
+                self.last_steps_per_sec = sps
+                fetched["steps_per_sec"] = round(sps, 2)
+                fetched["steps_per_sec_per_chip"] = round(
+                    sps / self._num_chips, 2)
         self._last_time = now
         self._last_step = step
         if self._is_chief:
@@ -59,14 +79,24 @@ class MetricsLogger:
             print(f"step {step}: {parts}", flush=True)
             if self._file:
                 self._file.write(json.dumps({"step": step, **fetched}) + "\n")
+            if self._events:
+                for name, value in fetched.items():
+                    self._events.scalar(step, name, value)
+                self._events.flush()
 
     def scalar(self, step: int, name: str, value: float) -> None:
         if self._is_chief:
             print(f"step {step}: {name}={value:.4f}", flush=True)
             if self._file:
                 self._file.write(json.dumps({"step": step, name: value}) + "\n")
+            if self._events:
+                self._events.scalar(step, name, value)
+                self._events.flush()
 
     def close(self):
         if self._file:
             self._file.close()
             self._file = None
+        if self._events:
+            self._events.close()
+            self._events = None

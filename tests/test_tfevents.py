@@ -1,0 +1,176 @@
+"""TensorBoard event-file writer (utils/tfevents.py) — framing, protobuf
+encoding, CRC verification, and the MetricsLogger integration.
+
+The reference wrote ``tf.summary`` scalars; these tests pin the rebuild's
+tfevents output to the on-disk format TensorBoard actually reads (TFRecord
+framing with masked CRC32C, Event/Summary proto wire layout).
+"""
+
+import glob
+import os
+import struct
+
+import pytest
+
+from distributedtensorflowexample_tpu.utils import tfevents
+
+
+def test_crc32c_known_answers():
+    # Canonical CRC32C check vectors (RFC 3720 / kernel test suite).
+    assert tfevents.crc32c(b"123456789") == 0xE3069283
+    assert tfevents.crc32c(b"") == 0x0
+    assert tfevents.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_varint_roundtrip():
+    for n in (0, 1, 127, 128, 300, 2 ** 21, 2 ** 35, 2 ** 63 - 1):
+        data = tfevents._varint(n)
+        got, i = tfevents._read_varint(data, 0)
+        assert got == n and i == len(data)
+
+
+def test_writer_roundtrip(tmp_path):
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.scalar(1, "loss", 2.5, wall_time=123.0)
+    w.scalar(2, "accuracy", 0.75, wall_time=124.0)
+    w.scalar(100, "loss", 0.125, wall_time=125.0)
+    w.close()
+
+    events = tfevents.read_events(w.path)
+    assert events[0]["file_version"] == "brain.Event:2"
+    scalars = [(e["step"], e["tag"], e["value"]) for e in events[1:]]
+    assert scalars == [(1, "loss", 2.5), (2, "accuracy", 0.75),
+                       (100, "loss", 0.125)]
+    assert events[1]["wall_time"] == 123.0
+
+
+def test_reader_rejects_corruption(tmp_path):
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.scalar(1, "loss", 1.0)
+    w.close()
+    with open(w.path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    with pytest.raises(ValueError, match="crc"):
+        tfevents.read_events(w.path)
+
+
+def test_filename_is_tensorboard_discoverable(tmp_path):
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.close()
+    assert os.path.basename(w.path).startswith("events.out.tfevents.")
+
+
+def test_record_framing_layout(tmp_path):
+    """First 12 bytes are len(u64le) + masked crc of the len bytes — the
+    exact TFRecord layout, byte for byte."""
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.close()
+    with open(w.path, "rb") as f:
+        raw = f.read()
+    (length,) = struct.unpack_from("<Q", raw, 0)
+    (hcrc,) = struct.unpack_from("<I", raw, 8)
+    assert hcrc == tfevents.masked_crc32c(raw[:8])
+    data = raw[12:12 + length]
+    (dcrc,) = struct.unpack_from("<I", raw, 12 + length)
+    assert dcrc == tfevents.masked_crc32c(data)
+
+
+def test_overflow_value_saturates_to_inf(tmp_path):
+    """A diverged loss (finite float64 > float32 max) must log as inf,
+    not crash the training loop at the log boundary."""
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.scalar(1, "loss", 1e39)
+    w.scalar(2, "loss", -1e39)
+    w.close()
+    vals = [e["value"] for e in tfevents.read_events(w.path) if "value" in e]
+    assert vals[0] == float("inf") and vals[1] == float("-inf")
+
+
+def test_truncated_tail_returns_valid_prefix(tmp_path):
+    """A killed writer leaves a partial final record; the reader must
+    return the complete prefix, not raise."""
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.scalar(1, "loss", 1.0)
+    w.scalar(2, "loss", 0.5)
+    w.close()
+    with open(w.path, "rb") as f:
+        raw = f.read()
+    for cut in (1, 5, 11, 20):  # truncate inside the last record's frames
+        with open(w.path, "wb") as f:
+            f.write(raw[:-cut])
+        events = tfevents.read_events(w.path)
+        assert [e["value"] for e in events if "value" in e] == [1.0]
+
+
+def test_negative_step_encodes_without_hang(tmp_path):
+    """Proto int64 negatives are 10-byte two's complement — must encode,
+    not spin forever in the varint loop."""
+    data = tfevents.encode_scalar_event(0.0, -1, "t", 1.0)
+    fields = {f: v for f, _w, v in tfevents._decode_fields(data)}
+    assert fields[2] == 0xFFFFFFFFFFFFFFFF  # -1 as unsigned two's complement
+
+
+def test_metrics_logger_writes_tfevents(tmp_path):
+    from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path), num_chips=2, log_every=1)
+    logger.start(0)
+    logger.maybe_log(1, {"loss": 3.0, "accuracy": 0.5})
+    logger.scalar(1, "eval_accuracy", 0.9)
+    logger.close()
+
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert len(files) == 1
+    events = tfevents.read_events(files[0])
+    by_tag = {e["tag"]: e["value"] for e in events if "tag" in e}
+    assert by_tag["loss"] == 3.0
+    assert by_tag["accuracy"] == 0.5
+    assert by_tag["eval_accuracy"] == pytest.approx(0.9, abs=1e-6)
+    assert "steps_per_sec" in by_tag
+
+
+def test_loop_excludes_hook_time_from_steps_per_sec():
+    """A slow hook (eval/checkpoint stand-in) must not depress the reported
+    training rate: 10 trivial steps + ~0.5s of hook sleeps must still report
+    a high steps/sec."""
+    import time
+
+    from distributedtensorflowexample_tpu.training.hooks import Hook
+    from distributedtensorflowexample_tpu.training.loop import TrainLoop
+    from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
+
+    class SlowHook(Hook):
+        def after_step(self, step, state, metrics):
+            time.sleep(0.05)
+            return False
+
+    class FakeState:
+        step = 0
+
+    logger = MetricsLogger(log_every=10)
+    loop = TrainLoop(lambda s, b: (s, {"loss": 0.0}), iter([None] * 10), 10,
+                     hooks=[SlowHook()], logger=logger)
+    loop.run(FakeState())
+    # Without exclusion the window would be ~0.5s -> ~20 steps/sec.
+    assert logger.last_steps_per_sec > 100
+
+
+def test_logger_excludes_hook_time():
+    """exclude() discounts non-training wall time from the window."""
+    import time
+
+    from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
+
+    logger = MetricsLogger(log_every=1)
+    logger.start(0)
+    time.sleep(0.05)          # "training" time
+    logger.exclude(10.0)      # pretend a 10s hook ran — must not be counted
+    logger.maybe_log(1, {"loss": 0.0})
+    # 1 step in (0.05s - 10s excluded) -> negative window would explode the
+    # rate; clamp behavior: with the exclusion larger than the window the
+    # logger must not report a bogus *small* rate.  (The realistic case —
+    # exclusion smaller than the window — is covered by the loop test.)
+    assert logger.last_steps_per_sec == 0.0 or logger.last_steps_per_sec > 20
