@@ -1,20 +1,24 @@
-"""Benchmark harness — emits ONE JSON line with the headline metric.
+"""Benchmark harness — one JSON line per contract workload, headline LAST.
 
-Headline (BASELINE.json "metric"): MNIST steps/sec/chip, sync-SGD.
+Headline (BASELINE.json "metric"): MNIST CNN steps/sec/chip, sync-SGD.
 The reference published no numbers (BASELINE.json "published": {}), so
-``vs_baseline`` is computed against this repo's own recorded baseline in
-``BASELINE_SELF.json`` when present, else 1.0.  The recorded baseline is
-this round's first measurement (host-fed pipeline, 590.8 steps/s/chip on
-one v5e chip) — the number the device-resident input path was built to
-beat.
+``vs_baseline`` is computed against this repo's own recorded baselines in
+``BASELINE_SELF.json`` (first-ever measurement per metric; the headline
+denominator is the round-1 host-fed pipeline, 590.8 steps/s/chip on one
+v5e chip — the number the device-resident input path was built to beat).
 
-Runs the real trainer stack: the dataset resident in HBM, batches
-gathered on device, the jitted sync-SGD step (parallel/sync.py) — the
-driver invokes this on a real TPU chip.  Exits cleanly (no hard kill
-needed): small fixed step counts.  The chip is reached through a shared
-tunnel with visible noisy-neighbor variance, so the measured window is
-the best of a few short repeats (steady-state rate, not a lucky queue
-flush — each repeat blocks on its own final metrics).
+Workloads (BASELINE.md "must emit exactly this table's metrics"):
+  config 1  mnist_softmax            device-resident, fused steps
+  config 2  mnist_cnn_async         local-SGD emulation, device-resident
+  config 4  cifar_resnet20          augmented, + MFU estimate
+  variants  mnist_cnn pallas_ce / fused_sgd   (hand-written kernels)
+  config 3  mnist_cnn_sync          HEADLINE — unroll sweep + roofline
+
+Each line carries a ``detail`` object: every repeat (the chip sits behind
+a shared tunnel with ~20x noisy-neighbor variance, so round-over-round
+comparisons need the spread, not just the max), the unroll sweep, and a
+pure-compute roofline probe (scanned fixed-batch steps, no per-call
+dispatch) for the headline.
 """
 
 from __future__ import annotations
@@ -24,71 +28,226 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 
-WARMUP_STEPS = 32
-MEASURE_STEPS = 320
 REPEATS = 3
-BATCH_PER_CHIP = 256
-UNROLL = 16           # SGD steps fused per compiled call (lax.scan)
+PEAK_FLOPS = float(os.environ.get("TPU_PEAK_FLOPS", 197e12))  # v5e bf16
 
 
-def main() -> None:
+def _load_baselines() -> dict:
+    if os.path.exists("BASELINE_SELF.json"):
+        try:
+            with open("BASELINE_SELF.json") as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            pass
+    return {}
+
+
+def _emit(metric: str, per_chip: float, baselines: dict, detail: dict) -> None:
+    baseline = baselines.get(metric)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": "steps/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 4) if baseline else 1.0,
+        "detail": detail,
+    }), flush=True)
+
+
+def _measure(step, ds, state, steps: int, unroll: int,
+             warmup_calls: int = 2) -> tuple[float, list, object]:
+    """Best-of-REPEATS steady-state rate; each repeat blocks on its own
+    final metrics so a queue flush can't masquerade as throughput."""
+    calls = max(1, steps // unroll)
+    actual_steps = calls * unroll
+    metrics = None
+    for _ in range(warmup_calls):
+        state, metrics = step(state, next(ds))
+    jax.block_until_ready(metrics)
+    rates = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, metrics = step(state, next(ds))
+        jax.block_until_ready(metrics)
+        rates.append(actual_steps / (time.perf_counter() - t0))
+    return max(rates), [round(r, 1) for r in rates], state
+
+
+def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
+          mesh, *, momentum: float = 0.9, ce_impl: str = "xla",
+          fused_opt: bool = False, augment: str = "none", lr: float = 0.05,
+          sync: bool = True, async_period: int = 8):
     import optax
 
     from distributedtensorflowexample_tpu.data import DeviceDataset
+    from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
     from distributedtensorflowexample_tpu.data.mnist import load_mnist
     from distributedtensorflowexample_tpu.models import build_model
-    from distributedtensorflowexample_tpu.parallel import (
-        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel import replicated_sharding
+    from distributedtensorflowexample_tpu.parallel.async_ps import (
+        make_indexed_async_train_step, make_worker_state)
     from distributedtensorflowexample_tpu.parallel.sync import (
         make_indexed_train_step)
     from distributedtensorflowexample_tpu.training.state import TrainState
 
-    mesh = make_mesh()
     num_chips = mesh.size
-    global_batch = BATCH_PER_CHIP * num_chips
-
-    train_x, train_y = load_mnist("/tmp/data", "train")
+    global_batch = batch_per_chip * num_chips
+    load = load_mnist if dataset == "mnist" else load_cifar10
+    sample = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
+    train_x, train_y = load("/tmp/data", "train")
+    # A fused window cannot exceed an epoch; on multi-chip meshes the
+    # growing global batch shrinks steps_per_epoch below the requested
+    # unroll constants.
+    unroll = min(unroll, len(train_y) // global_batch)
     ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh, seed=0,
-                       steps_per_next=UNROLL)
+                       steps_per_next=unroll)
 
+    model = build_model(model_name, dropout=0.5)
+    if fused_opt:
+        from distributedtensorflowexample_tpu.ops.pallas import (
+            fused_momentum_sgd)
+        tx = fused_momentum_sgd(lr, momentum=momentum, mesh=mesh)
+    elif momentum > 0:
+        tx = optax.sgd(lr, momentum=momentum)
+    else:
+        tx = optax.sgd(lr)
+    state = TrainState.create_sharded(
+        model, tx, (global_batch,) + sample, 0, replicated_sharding(mesh))
+    if sync:
+        step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
+                                       mesh=mesh, unroll_steps=unroll,
+                                       ce_impl=ce_impl, augment=augment)
+    else:
+        state = make_worker_state(state, num_chips, mesh)
+        step = make_indexed_async_train_step(
+            num_chips, async_period, global_batch, ds.steps_per_epoch,
+            ce_impl=ce_impl, mesh=mesh, unroll_steps=unroll, augment=augment)
+    return step, ds, state, unroll
+
+
+def _roofline_probe(mesh, batch_per_chip: int, length: int = 256) -> list:
+    """Pure device step rate: `length` CNN steps scanned over a FIXED
+    resident batch in one compiled call — no gather, no per-call dispatch.
+    The gap between this and the measured path is dispatch/input overhead."""
+    import optax
+
+    from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel import (
+        batch_sharding, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.sync import _build_step_fn
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    global_batch = batch_per_chip * mesh.size
+    x, y = make_synthetic(global_batch, (28, 28, 1), 10, seed=0)
+    batch = jax.device_put({"image": jnp.asarray(x), "label": jnp.asarray(y)},
+                           batch_sharding(mesh))
     model = build_model("mnist_cnn", dropout=0.5)
     state = TrainState.create_sharded(
         model, optax.sgd(0.05, momentum=0.9),
         (global_batch, 28, 28, 1), 0, replicated_sharding(mesh))
-    step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
-                                   mesh=mesh, unroll_steps=UNROLL)
+    inner = _build_step_fn(mesh=mesh)
 
-    best = 0.0
-    with mesh:
-        for _ in range(WARMUP_STEPS // UNROLL):
-            state, metrics = step(state, next(ds))
+    @jax.jit
+    def probe(state, batch):
+        new_state, stacked = jax.lax.scan(
+            lambda st, _: inner(st, batch), state, None, length=length)
+        return new_state, jax.tree.map(lambda m: m[-1], stacked)
+
+    state, metrics = probe(state, batch)
+    jax.block_until_ready(metrics)
+    rates = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        state, metrics = probe(state, batch)
         jax.block_until_ready(metrics)
+        rates.append(length / (time.perf_counter() - t0))
+    return [round(r, 1) for r in rates]
 
-        for _ in range(REPEATS):
-            t0 = time.perf_counter()
-            for _ in range(MEASURE_STEPS // UNROLL):
-                state, metrics = step(state, next(ds))
-            jax.block_until_ready(metrics)
-            best = max(best, MEASURE_STEPS / (time.perf_counter() - t0))
 
-    per_chip = best / num_chips
+def _flops_per_step(step, state, data, unroll: int) -> float | None:
+    try:
+        cost = step.lower(state, data).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"]) / unroll
+    except Exception:
+        return None
 
-    baseline = None
-    if os.path.exists("BASELINE_SELF.json"):
-        try:
-            with open("BASELINE_SELF.json") as f:
-                baseline = json.load(f).get("mnist_cnn_steps_per_sec_per_chip")
-        except (json.JSONDecodeError, OSError):
-            baseline = None
-    vs_baseline = round(per_chip / baseline, 4) if baseline else 1.0
 
-    print(json.dumps({
-        "metric": "mnist_cnn_sync_steps_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "steps/sec/chip",
-        "vs_baseline": vs_baseline,
-    }))
+def main() -> None:
+    from distributedtensorflowexample_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    num_chips = mesh.size
+    baselines = _load_baselines()
+
+    with mesh:
+        # --- config 1: local MNIST softmax -------------------------------
+        step, ds, state, u = _make("softmax", "mnist", 100, 128, mesh,
+                                   momentum=0.0, lr=0.5)
+        best, rates, _ = _measure(step, ds, state, 1024, u)
+        _emit("mnist_softmax_steps_per_sec_per_chip", best / num_chips,
+              baselines, {"repeats": rates, "unroll": u,
+                          "batch_per_chip": 100})
+
+        # --- config 4: CIFAR-10 ResNet-20, augmented ---------------------
+        step, ds, state, u = _make("resnet20", "cifar10", 256, 8, mesh,
+                                   augment="cifar", lr=0.1)
+        flops = _flops_per_step(step, state, next(ds), u)
+        best, rates, _ = _measure(step, ds, state, 96, u)
+        per_chip = best / num_chips
+        # flops is whole-module (all devices); MFU = F*S_global/(N*peak)
+        # = F*per_chip/peak.
+        mfu = (flops * per_chip / PEAK_FLOPS) if flops else None
+        _emit("cifar_resnet20_steps_per_sec_per_chip", per_chip, baselines,
+              {"repeats": rates, "unroll": u, "batch_per_chip": 256,
+               "flops_per_step": flops,
+               "mfu": round(mfu, 4) if mfu is not None else None})
+
+        # --- config 2: MNIST CNN async (local-SGD emulation) -------------
+        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
+                                   sync=False)
+        best, rates, _ = _measure(step, ds, state, 512, u)
+        _emit("mnist_cnn_async_steps_per_sec_per_chip", best / num_chips,
+              baselines, {"repeats": rates, "unroll": u,
+                          "batch_per_chip": 256, "async_period": 8})
+
+        # --- hand-written kernel variants on the headline workload -------
+        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
+                                   ce_impl="pallas")
+        best, rates, _ = _measure(step, ds, state, 512, u)
+        _emit("mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip",
+              best / num_chips, baselines,
+              {"repeats": rates, "unroll": u, "batch_per_chip": 256})
+
+        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
+                                   fused_opt=True)
+        best, rates, _ = _measure(step, ds, state, 512, u)
+        _emit("mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip",
+              best / num_chips, baselines,
+              {"repeats": rates, "unroll": u, "batch_per_chip": 256})
+
+        # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
+        sweep = {}
+        best_overall, best_unroll, best_rates = 0.0, None, []
+        spe = 60000 // (256 * num_chips)   # full epoch = the unroll ceiling
+        for unroll in sorted({min(u, spe) for u in (16, 64, 128, spe)}):
+            step, ds, state, u = _make("mnist_cnn", "mnist", 256, unroll,
+                                       mesh)
+            best, rates, _ = _measure(step, ds, state,
+                                      max(512, u * 4), u)
+            sweep[str(u)] = rates
+            if best > best_overall:
+                best_overall, best_unroll, best_rates = best, u, rates
+        roofline = _roofline_probe(mesh, 256)
+        _emit("mnist_cnn_sync_steps_per_sec_per_chip",
+              best_overall / num_chips, baselines,
+              {"repeats": best_rates, "best_unroll": best_unroll,
+               "unroll_sweep": sweep, "batch_per_chip": 256,
+               "roofline_probe": roofline})
 
 
 if __name__ == "__main__":

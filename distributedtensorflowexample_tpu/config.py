@@ -67,7 +67,10 @@ class RunConfig:
     num_devices: int = 0            # 0 = all visible devices
     sync_mode: str = "sync"         # sync | async (async = local-SGD emulation)
     async_period: int = 8           # param-averaging period for async emulation
-    replicas_to_aggregate: int = 0  # SyncReplicasOptimizer compat; 0 = all
+    replicas_to_aggregate: int = 0  # SyncReplicasOptimizer partial
+                                    # aggregation: R of N replica gradients
+                                    # enter each update (rotating subset);
+                                    # 0 = all
     dtype: str = "bfloat16"         # compute dtype on TPU (params stay f32)
 
     # --- hand-written TPU kernels (ops/pallas) ---

@@ -7,17 +7,29 @@ scale that copy is THE bottleneck on TPU — measured ~1.4 ms of H2D per
 step against a ~0.07 ms compiled step on one v5e chip — and no amount of
 prefetch depth hides a transfer that is 20x the step.  MNIST (183 MB) and
 CIFAR-10 (590 MB) fit trivially in HBM, so the TPU-native design uploads
-the split once and moves only nothing per step: the epoch's shuffled index
+the split once and moves nothing per step: the epoch's shuffled index
 order is itself computed on device (``jax.random.permutation``), and the
 step slices its batch out of it by global-step position.
 
-Per-epoch host work: one tiny jitted permutation dispatch.  Per-step host
-work: a dict re-yield.  Shuffling semantics match the host ``Batcher``:
-epochs without replacement, remainder rows dropped per epoch.
+Epoch double-buffering: the dataset always holds TWO epoch permutations in
+one device array of shape ``(2, epoch_len)`` — epoch ``e`` in slot
+``e % 2``, epoch ``e+1`` in the other slot.  The train step picks the slot
+from ``state.step // steps_per_epoch`` per fused sub-step, so one compiled
+multi-step call may cross an epoch boundary mid-scan.  That decouples the
+dispatch-amortizing unroll (``steps_per_next`` / ``unroll_steps``) from
+epoch arithmetic entirely: any unroll up to ``steps_per_epoch`` works, and
+the next epoch's permutation is computed (asynchronously, off the critical
+path) a whole epoch before it is first read.
+
+Shuffling semantics match the host ``Batcher``: epochs without
+replacement, the sub-batch remainder rows dropped per epoch.
+
+Per-epoch host work: one tiny jitted row update into the perm pair.
+Per-step host work: a dict re-yield.
 
 Multi-host: every process holds the identical split (same loaders, same
 seed — the reference's workers did the same), the arrays are replicated on
-the mesh, and every process computes the identical permutation; the train
+the mesh, and every process computes the identical permutations; the train
 step re-shards each gathered batch along the data axis on device.
 """
 
@@ -31,60 +43,36 @@ import numpy as np
 class DeviceDataset:
     """Iterator yielding ``{"images", "labels", "perm"}`` device pytrees.
 
-    The arrays are the same device buffers every step — only ``perm`` is
-    replaced, once per epoch.  Pass ``start_step`` (e.g. after a resume)
-    so epoch boundaries line up with the step's position arithmetic.
+    ``perm`` has shape ``(2, epoch_len)``: the current epoch's shuffled
+    index order in slot ``epoch % 2``, the next epoch's in the other slot.
+    The arrays are the same device buffers every step — only one perm row
+    is replaced, once per epoch.  Pass ``start_step`` (e.g. after a
+    resume) so epoch slots line up with the step's position arithmetic.
     """
-
-    # Epochs are truncated to a multiple of a power-of-two granule derived
-    # from (dataset size, batch) ONLY — never from steps_per_next — so
-    # changing steps_per_loop between runs or across a resume cannot
-    # silently remap which permutation/position a given global step sees.
-    # The granule is the largest power of two ≤ the cap whose truncation
-    # drops at most 1/16 of the epoch's batches.
-    EPOCH_MULTIPLE_CAP = 32
-
-    @classmethod
-    def epoch_multiple(cls, raw_steps: int) -> int:
-        m = 1
-        while m * 2 <= min(cls.EPOCH_MULTIPLE_CAP, raw_steps):
-            m *= 2
-        while m > 1 and (raw_steps % m) * 16 > raw_steps:
-            m //= 2
-        return m
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
                  shuffle: bool = True, start_step: int = 0,
                  steps_per_next: int = 1):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
-        the train step's ``unroll_steps`` so the permutation swaps on the
-        right call.  Must be a power of two dividing the epoch multiple
-        (a scan window never crosses an epoch boundary)."""
+        the train step's ``unroll_steps`` so the perm pair is refreshed on
+        the right call.  Any value in ``[1, steps_per_epoch]`` works (a
+        fused window may cross one epoch boundary, never two)."""
         if len(images) < batch_size:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than "
                 f"batch {batch_size}")
         self._n = len(images)
-        raw_steps = self._n // batch_size
-        multiple = self.epoch_multiple(raw_steps)
-        if steps_per_next < 1 or multiple % steps_per_next:
-            raise ValueError(
-                f"steps_per_next {steps_per_next} must be a power of two "
-                f"dividing {multiple} (epoch multiple for {self._n} "
-                f"examples at batch {batch_size})")
-        self.steps_per_epoch = (raw_steps // multiple) * multiple
+        self.steps_per_epoch = self._n // batch_size
         self.epoch_len = self.steps_per_epoch * batch_size
-        if not shuffle and self.steps_per_epoch < raw_steps:
-            import warnings
-            warnings.warn(
-                f"shuffle=False with epoch truncated from {raw_steps} to "
-                f"{self.steps_per_epoch} steps: the last "
-                f"{self._n - self.epoch_len} examples will never be seen")
+        if not 1 <= steps_per_next <= self.steps_per_epoch:
+            raise ValueError(
+                f"steps_per_next {steps_per_next} must be in [1, "
+                f"steps_per_epoch={self.steps_per_epoch}] (a fused window "
+                f"may cross at most one epoch boundary)")
         self._spn = steps_per_next
         self._step = int(start_step)
-        self._epoch = None
-        self._perm = None
+        self._slot_epochs: list[int | None] = [None, None]
 
         if mesh is not None:
             from distributedtensorflowexample_tpu.parallel.mesh import (
@@ -109,17 +97,34 @@ class DeviceDataset:
                 order = jnp.arange(self._n)
             return order[:self.epoch_len].astype(jnp.int32)
 
-        self._make_perm = (jax.jit(make_perm, out_shardings=repl)
-                           if repl is not None else jax.jit(make_perm))
+        def set_row(pair, row, slot):
+            return jax.lax.dynamic_update_slice(pair, row[None], (slot, 0))
+
+        jit_kw = {"out_shardings": repl} if repl is not None else {}
+        self._make_perm = jax.jit(make_perm, **jit_kw)
+        # Donated: the stale epoch's row is overwritten in place in HBM;
+        # the runtime sequences the write after any in-flight reads.
+        self._set_row = jax.jit(set_row, donate_argnums=0, **jit_kw)
+        self._pair = jax.jit(
+            lambda: jnp.zeros((2, self.epoch_len), jnp.int32), **jit_kw)()
+
+    def _ensure_epoch(self, epoch: int) -> None:
+        slot = epoch % 2
+        if self._slot_epochs[slot] != epoch:
+            perm = self._make_perm(jnp.asarray(epoch, jnp.int32))
+            self._pair = self._set_row(self._pair, perm,
+                                       jnp.asarray(slot, jnp.int32))
+            self._slot_epochs[slot] = epoch
 
     def __iter__(self):
         return self
 
     def __next__(self):
         epoch = self._step // self.steps_per_epoch
-        if epoch != self._epoch:
-            self._epoch = epoch
-            self._perm = self._make_perm(jnp.asarray(epoch, jnp.int32))
+        # Both the window's possible epochs stay resident: e in slot e%2,
+        # e+1 in the other — computed one epoch ahead (double-buffered).
+        self._ensure_epoch(epoch)
+        self._ensure_epoch(epoch + 1)
         self._step += self._spn
         return {"images": self.images, "labels": self.labels,
-                "perm": self._perm}
+                "perm": self._pair}

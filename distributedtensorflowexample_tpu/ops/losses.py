@@ -12,15 +12,21 @@ import jax
 import jax.numpy as jnp
 
 
-def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
-                          label_smoothing: float = 0.0) -> jnp.ndarray:
-    """Mean cross-entropy from int labels. logits [B,C] f32, labels [B] int."""
+def softmax_cross_entropy_rows(logits: jnp.ndarray, labels: jnp.ndarray,
+                               label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Per-example cross-entropy [B] from int labels; logits [B,C]."""
     num_classes = logits.shape[-1]
     onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
     if label_smoothing > 0.0:
         onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
     log_probs = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.sum(onehot * log_probs, axis=-1))
+    return -jnp.sum(onehot * log_probs, axis=-1)
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                          label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean cross-entropy from int labels. logits [B,C] f32, labels [B] int."""
+    return jnp.mean(softmax_cross_entropy_rows(logits, labels, label_smoothing))
 
 
 def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

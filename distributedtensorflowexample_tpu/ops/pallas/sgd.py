@@ -2,21 +2,23 @@
 
 The reference's update was a native TF ``ApplyMomentum`` op per variable
 (library C++, SURVEY.md §2 native-dependency table).  This kernel is the
-TPU equivalent: for each parameter leaf, one VMEM pass computes
+TPU equivalent: one VMEM pass computes
 
     m_new = mu * m + g          (optax.sgd(momentum=mu) trace semantics)
     p_new = p - lr * m_new
 
-in one fused pass per leaf.  ``input_output_aliases`` lets XLA reuse the
-kernel operands' buffers for the outputs; note the operands here are the
-padded/flattened temporaries built around the kernel, so the aliasing
-saves the kernel-internal copies, not the whole-step HBM round-trip.
-``lr`` arrives as a traced (1, 1) SMEM scalar so LR schedules stay
-dynamic; ``mu`` is compile-time static.
+over the WHOLE parameter set at once.  Every leaf is packed into a single
+flat (rows, 128) f32 buffer — the momentum trace lives flat in the
+optimizer state, params/grads are flattened per step — so the apply is ONE
+``pallas_call`` regardless of how many parameter tensors the model has
+(ResNet-20 has ~65; the round-1 per-leaf version launched ~65 kernels plus
+per-leaf pad/unpad traffic per step).  ``input_output_aliases`` lets XLA
+reuse the flat operands' buffers for the outputs.  ``lr`` arrives as a
+traced (1, 1) SMEM scalar so LR schedules stay dynamic; ``mu`` is
+compile-time static.
 
-Leaves are flattened and padded to (rows, 128) lanes; the pad tail is
-updated too (momentum of a zero-gradient pad stays zero, params stay put),
-so no masking is needed.
+Segment boundaries inside the flat buffer need no masking: the pad tail's
+gradient is zero, so its momentum stays zero and its params stay put.
 """
 
 from __future__ import annotations
@@ -42,27 +44,40 @@ def _sgd_kernel(lr_ref, p_ref, m_ref, g_ref, p_out, m_out, *, mu: float):
     m_out[:] = m_new
 
 
-def _pick_block(rows: int) -> int:
-    return pick_block(rows, _ROW_BLOCK)
-
-
-def _apply_leaf(param, mom, grad, lr2d, mu: float, interpret: bool):
-    shape, dtype, n = param.shape, param.dtype, param.size
+def _num_rows(n: int) -> int:
     rows = max(8, (n + _LANES - 1) // _LANES)
-    rows = ((rows + 7) // 8) * 8
-    padded = rows * _LANES
+    return ((rows + 7) // 8) * 8
 
-    def flat(x):
-        x = x.astype(jnp.float32).reshape(-1)
-        return jnp.pad(x, (0, padded - n)).reshape(rows, _LANES)
 
-    block = _pick_block(rows)
-    grid = (rows // block,)
+def _flatten_leaves(leaves, rows: int) -> jnp.ndarray:
+    flat = jnp.concatenate(
+        [jnp.ravel(x).astype(jnp.float32) for x in leaves])
+    return jnp.pad(flat, (0, rows * _LANES - flat.size)).reshape(rows, _LANES)
+
+
+def _unflatten_like(flat: jnp.ndarray, leaves, treedef):
+    """Slice a flat buffer back into the shapes/dtypes of ``leaves``."""
+    flat = flat.reshape(-1)
+    out, offset = [], 0
+    for leaf in leaves:
+        out.append(flat[offset:offset + leaf.size]
+                   .reshape(leaf.shape).astype(leaf.dtype))
+        offset += leaf.size
+    return treedef.unflatten(out)
+
+
+def fused_sgd_flat(p_flat, m_flat, g_flat, lr, mu: float,
+                   interpret: bool):
+    """One momentum-SGD pass over flat (rows, 128) f32 buffers: a single
+    ``pallas_call`` with a 1-D grid over row blocks."""
+    rows = p_flat.shape[0]
+    lr2d = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    block = pick_block(rows, _ROW_BLOCK)
     spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
-    p_new, m_new = pl.pallas_call(
-        functools.partial(_sgd_kernel, mu=mu),
-        grid=grid,
+    return pl.pallas_call(
+        functools.partial(_sgd_kernel, mu=float(mu)),
+        grid=(rows // block,),
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
@@ -73,25 +88,30 @@ def _apply_leaf(param, mom, grad, lr2d, mu: float, interpret: bool):
                    jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)),
         input_output_aliases={1: 0, 2: 1},
         interpret=interpret,
-    )(lr2d, flat(param), flat(mom), flat(grad))
-    unflat = lambda x: x.reshape(-1)[:n].reshape(shape).astype(dtype)
-    return unflat(p_new), unflat(m_new)
+    )(lr2d, p_flat, m_flat, g_flat)
+
+
+def _auto_interpret(interpret: bool | None) -> bool:
+    if interpret is None:
+        return jax.default_backend() not in ("tpu", "axon")
+    return interpret
 
 
 class FusedSgdState(NamedTuple):
     count: jnp.ndarray     # step counter for LR schedules
-    trace: object          # momentum tree, same structure as params
+    trace: jnp.ndarray     # momentum, flat (rows, 128) f32
 
 
 def fused_momentum_sgd(learning_rate, momentum: float = 0.9, mesh=None):
     """Optax-compatible transformation backed by the fused Pallas kernel.
 
     Same math as ``optax.sgd(learning_rate, momentum=momentum)``, but the
-    state pytree differs (``FusedSgdState`` vs optax's tuple), so a
-    checkpoint written with one cannot be restored with the other — pick
-    the flag per run, not mid-experiment.  The optax contract returns
-    *updates* (applied by ``optax.apply_updates``), so the kernel's result
-    is expressed as ``p_new - p``; XLA folds the add/sub pair away.
+    state pytree differs (``FusedSgdState`` with a FLAT momentum buffer vs
+    optax's per-leaf tuple), so a checkpoint written with one cannot be
+    restored with the other — pick the flag per run, not mid-experiment.
+    The optax contract returns *updates* (applied by
+    ``optax.apply_updates``), so the kernel's result is expressed as
+    ``p_new - p``; XLA folds the add/sub pair away.
 
     A ``pallas_call`` is a custom call XLA cannot auto-partition: on a
     multi-device mesh pass ``mesh`` so the kernel runs per-device under
@@ -101,26 +121,35 @@ def fused_momentum_sgd(learning_rate, momentum: float = 0.9, mesh=None):
     import optax
 
     def init(params):
+        n = sum(x.size for x in jax.tree.leaves(params))
+        rows = _num_rows(n)
         return FusedSgdState(count=jnp.zeros([], jnp.int32),
-                             trace=jax.tree.map(jnp.zeros_like, params))
+                             trace=jnp.zeros((rows, _LANES), jnp.float32))
 
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_momentum_sgd requires params")
         lr = learning_rate(state.count) if callable(learning_rate) \
             else learning_rate
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        rows = state.trace.shape[0]
+        p_flat = _flatten_leaves(leaves_p, rows)
+        g_flat = _flatten_leaves(leaves_g, rows)
+        interpret = _auto_interpret(None)
         if mesh is not None and mesh.size > 1:
             from jax.sharding import PartitionSpec as P
             apply = jax.shard_map(
-                lambda p, m, g, lr_: fused_sgd_apply(p, m, g, lr_, momentum),
+                lambda p, m, g, lr_: fused_sgd_flat(p, m, g, lr_, momentum,
+                                                    interpret),
                 mesh=mesh, in_specs=(P(), P(), P(), P()),
                 out_specs=(P(), P()), check_vma=False)
-            p_new, m_new = apply(params, state.trace, grads,
+            p_new, m_new = apply(p_flat, state.trace, g_flat,
                                  jnp.asarray(lr, jnp.float32))
         else:
-            p_new, m_new = fused_sgd_apply(params, state.trace, grads, lr,
-                                           momentum)
-        updates = jax.tree.map(lambda a, b: a - b, p_new, params)
+            p_new, m_new = fused_sgd_flat(p_flat, state.trace, g_flat, lr,
+                                          momentum, interpret)
+        updates = _unflatten_like(p_new - p_flat, leaves_p, treedef)
         return updates, FusedSgdState(count=state.count + 1, trace=m_new)
 
     return optax.GradientTransformation(init, update)
@@ -128,20 +157,18 @@ def fused_momentum_sgd(learning_rate, momentum: float = 0.9, mesh=None):
 
 def fused_sgd_apply(params, momentum, grads, lr, mu: float = 0.9,
                     interpret: bool | None = None):
-    """Apply one momentum-SGD step to every leaf; returns (params, momentum).
+    """Apply one momentum-SGD step to a pytree; returns (params, momentum)
+    as trees (parity-test surface; the optax path keeps momentum flat).
 
     ``lr`` may be a traced scalar (schedule output).  ``interpret=None``
     auto-selects interpret mode off-TPU for CPU testing.
     """
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
-    lr2d = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     leaves_p, treedef = jax.tree.flatten(params)
     leaves_m = treedef.flatten_up_to(momentum)
     leaves_g = treedef.flatten_up_to(grads)
-    out_p, out_m = [], []
-    for p, m, g in zip(leaves_p, leaves_m, leaves_g):
-        np_, nm = _apply_leaf(p, m, g, lr2d, float(mu), interpret)
-        out_p.append(np_)
-        out_m.append(nm)
-    return treedef.unflatten(out_p), treedef.unflatten(out_m)
+    rows = _num_rows(sum(x.size for x in leaves_p))
+    p_new, m_new = fused_sgd_flat(
+        _flatten_leaves(leaves_p, rows), _flatten_leaves(leaves_m, rows),
+        _flatten_leaves(leaves_g, rows), lr, mu, _auto_interpret(interpret))
+    return (_unflatten_like(p_new, leaves_p, treedef),
+            _unflatten_like(m_new, leaves_p, treedef))

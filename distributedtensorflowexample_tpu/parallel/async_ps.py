@@ -29,9 +29,10 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from distributedtensorflowexample_tpu.ops.losses import (
-    accuracy, softmax_cross_entropy)
+from distributedtensorflowexample_tpu.ops.losses import accuracy
 from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+from distributedtensorflowexample_tpu.parallel.sync import (
+    make_device_gather, make_loss_rows)
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
@@ -69,53 +70,62 @@ def consolidate(state: TrainState) -> TrainState:
                          if state.batch_stats else state.batch_stats)
 
 
-def make_async_train_step(num_workers: int, period: int,
-                          label_smoothing: float = 0.0) -> Callable:
-    """Build the jitted local-SGD step over worker-tiled state.
+def _build_async_step_fn(num_workers: int, period: int,
+                         label_smoothing: float = 0.0, ce_impl: str = "xla",
+                         mesh=None) -> Callable:
+    """The un-jitted local-SGD (state, batch) -> (state, metrics) body over
+    worker-tiled state, shared by the host-fed and indexed factories.
 
-    Batch arrives as the usual global batch sharded on DATA_AXIS; it is
-    reshaped to [workers, per_worker_batch, ...] (device-local, no data
-    movement) and vmapped.
+    The batch arrives as the usual global batch sharded on DATA_AXIS; it
+    is reshaped to [workers, per_worker_batch, ...] (device-local, no data
+    movement).  Per-worker gradients come from ONE ``value_and_grad`` of
+    the summed per-worker mean losses: worker ``w``'s parameters only
+    reach ``loss_w``, so d(sum)/d(params_w) IS that worker's gradient —
+    same math as a per-worker grad under vmap, but the loss head runs on
+    the worker-major flattened [W*Bw, C] logits OUTSIDE the vmap, which
+    lets the Pallas CE kernel apply under its usual shard_map-over-batch
+    pattern (a ``pallas_call`` has no batching rule XLA can partition).
     """
     period = max(1, int(period))
+    loss_rows = make_loss_rows(label_smoothing, ce_impl, mesh)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         has_bn = bool(state.batch_stats)
-
-        def per_worker(params, opt_state, stats, wbatch, rng):
-            def loss_fn(p):
-                variables = {"params": p}
-                if has_bn:
-                    variables["batch_stats"] = stats
-                    logits, updated = state.apply_fn(
-                        variables, wbatch["image"], train=True,
-                        rngs={"dropout": rng}, mutable=["batch_stats"])
-                    new_stats = updated["batch_stats"]
-                else:
-                    logits = state.apply_fn(variables, wbatch["image"],
-                                            train=True, rngs={"dropout": rng})
-                    new_stats = stats
-                loss = softmax_cross_entropy(logits, wbatch["label"],
-                                             label_smoothing)
-                return loss, (logits, new_stats)
-
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            updates, new_opt = state.tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            metrics = {"loss": loss,
-                       "accuracy": accuracy(logits, wbatch["label"])}
-            return new_params, new_opt, new_stats, metrics
+        W = num_workers
 
         # [G, ...] -> [W, G/W, ...]; shards are device-local so this is free.
         wbatch = jax.tree.map(
-            lambda x: x.reshape((num_workers, x.shape[0] // num_workers)
-                                + x.shape[1:]), batch)
+            lambda x: x.reshape((W, x.shape[0] // W) + x.shape[1:]), batch)
         step_rng = jax.random.fold_in(state.rng, state.step)
-        worker_rngs = jax.random.split(step_rng, num_workers)
-        new_params, new_opt, new_stats, metrics = jax.vmap(per_worker)(
-            state.params, state.opt_state, state.batch_stats, wbatch,
-            worker_rngs)
+        worker_rngs = jax.random.split(step_rng, W)
+        flat_labels = wbatch["label"].reshape(-1)
+
+        def loss_all(stacked_params):
+            def fwd(params, stats, image, rng):
+                variables = {"params": params}
+                if has_bn:
+                    variables["batch_stats"] = stats
+                    logits, updated = state.apply_fn(
+                        variables, image, train=True,
+                        rngs={"dropout": rng}, mutable=["batch_stats"])
+                    return logits, updated["batch_stats"]
+                logits = state.apply_fn(variables, image, train=True,
+                                        rngs={"dropout": rng})
+                return logits, stats
+
+            logits, new_stats = jax.vmap(fwd)(
+                stacked_params, state.batch_stats, wbatch["image"],
+                worker_rngs)
+            rows = loss_rows(logits.reshape(-1, logits.shape[-1]),
+                             flat_labels)
+            loss_w = rows.reshape(W, -1).mean(axis=1)
+            return jnp.sum(loss_w), (loss_w, logits, new_stats)
+
+        (_, (loss_w, logits, new_stats)), grads = jax.value_and_grad(
+            loss_all, has_aux=True)(state.params)
+        updates, new_opt = jax.vmap(state.tx.update)(
+            grads, state.opt_state, state.params)
+        new_params = jax.vmap(optax.apply_updates)(state.params, updates)
 
         new_step = state.step + 1
 
@@ -129,7 +139,52 @@ def make_async_train_step(num_workers: int, period: int,
                                   average, lambda t: t, new_params)
         new_state = state.replace(step=new_step, params=new_params,
                                   opt_state=new_opt, batch_stats=new_stats)
-        metrics = jax.tree.map(lambda m: jnp.mean(m), metrics)
+        metrics = {"loss": jnp.mean(loss_w),
+                   "accuracy": accuracy(
+                       logits.reshape(-1, logits.shape[-1]), flat_labels)}
         return new_state, metrics
+
+    return step
+
+
+def make_async_train_step(num_workers: int, period: int,
+                          label_smoothing: float = 0.0, ce_impl: str = "xla",
+                          mesh=None) -> Callable:
+    """Build the jitted host-fed local-SGD step over worker-tiled state."""
+    return jax.jit(_build_async_step_fn(num_workers, period, label_smoothing,
+                                        ce_impl, mesh), donate_argnums=0)
+
+
+def make_indexed_async_train_step(num_workers: int, period: int,
+                                  batch_size: int, steps_per_epoch: int,
+                                  label_smoothing: float = 0.0,
+                                  ce_impl: str = "xla", mesh=None,
+                                  unroll_steps: int = 1,
+                                  augment: str = "none") -> Callable:
+    """Local-SGD step over a device-resident dataset — async's analog of
+    ``sync.make_indexed_train_step``: same on-device gather from the
+    two-slot perm pair, same ``lax.scan`` multi-step fusion; the
+    period-aligned worker averaging runs inside the scan (``new_step %
+    period`` is exact whatever the unroll), so fused windows and averaging
+    periods compose freely."""
+    if not 1 <= unroll_steps <= steps_per_epoch:
+        raise ValueError(
+            f"unroll_steps {unroll_steps} must be in [1, steps_per_epoch="
+            f"{steps_per_epoch}] (a fused window may cross at most one "
+            f"epoch boundary)")
+    inner = _build_async_step_fn(num_workers, period, label_smoothing,
+                                 ce_impl, mesh)
+    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh)
+
+    def one(state: TrainState, data) -> tuple[TrainState, dict]:
+        return inner(state, gather(state.step, state.rng, data))
+
+    if unroll_steps == 1:
+        return jax.jit(one, donate_argnums=0)
+
+    def step(state: TrainState, data) -> tuple[TrainState, dict]:
+        new_state, stacked = jax.lax.scan(
+            lambda st, _: one(st, data), state, None, length=unroll_steps)
+        return new_state, jax.tree.map(lambda m: jnp.mean(m, axis=0), stacked)
 
     return jax.jit(step, donate_argnums=0)

@@ -23,13 +23,82 @@ import optax
 
 from distributedtensorflowexample_tpu.data.pipeline import put_global_batch
 from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
-from distributedtensorflowexample_tpu.ops.losses import (
-    accuracy, softmax_cross_entropy)
+from distributedtensorflowexample_tpu.ops.losses import accuracy
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
-def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
+def make_loss_rows(label_smoothing: float = 0.0, ce_impl: str = "xla",
                    mesh=None) -> Callable:
+    """Per-example loss head [B,C] -> [B], shared by the sync and async
+    step builders.
+
+    ``ce_impl="pallas"`` uses the fused Pallas kernel.  A ``pallas_call``
+    is a custom call XLA cannot auto-partition, so on a multi-device mesh
+    the kernel runs per-shard under ``jax.shard_map`` over the batch axis;
+    reductions outside it remain ordinary jnp ops, keeping the gradient
+    psum identical to the XLA path.
+    """
+    if ce_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown ce_impl {ce_impl!r}")
+    if ce_impl == "xla":
+        from distributedtensorflowexample_tpu.ops.losses import (
+            softmax_cross_entropy_rows)
+        return lambda l, y: softmax_cross_entropy_rows(l, y, label_smoothing)
+    from distributedtensorflowexample_tpu.ops.pallas import (
+        fused_softmax_cross_entropy_rows)
+    fused = lambda l, y: fused_softmax_cross_entropy_rows(l, y,
+                                                          label_smoothing)
+    if mesh is not None and mesh.size > 1:
+        from jax.sharding import PartitionSpec as P
+        fused = jax.shard_map(fused, mesh=mesh,
+                              in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                              out_specs=P(DATA_AXIS), check_vma=False)
+    return fused
+
+
+def make_device_gather(batch_size: int, steps_per_epoch: int,
+                       augment: str = "none", mesh=None) -> Callable:
+    """(step, rng, data) -> batch: the on-device minibatch gather from a
+    resident split (see ``data.DeviceDataset``), shared by the sync and
+    async indexed step builders."""
+    if augment not in ("none", "cifar"):
+        raise ValueError(f"unknown augment {augment!r}")
+
+    def gather(step, rng, data):
+        # In-epoch position from the global step; modulo first so the
+        # int32 product can't overflow on long runs.  The epoch's parity
+        # names its slot in the two-row perm pair (see DeviceDataset).
+        slot = (step // steps_per_epoch) % 2
+        pos = (step % steps_per_epoch) * batch_size
+        idx = jax.lax.dynamic_slice(data["perm"], (slot, pos),
+                                    (1, batch_size))[0]
+        batch = {"image": jnp.take(data["images"], idx, axis=0),
+                 "label": jnp.take(data["labels"], idx, axis=0)}
+        if augment == "cifar":
+            # On-device crop/flip (data/augment_device.py): a dedicated
+            # stream folded from the state rng — disjoint from the
+            # dropout stream, which folds in only the step.
+            from distributedtensorflowexample_tpu.data.augment_device import (
+                cifar_augment_device)
+            akey = jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), step)
+            batch["image"] = cifar_augment_device(batch["image"], akey)
+        if mesh is not None and mesh.size > 1:
+            # Dataset + perm are replicated, so the gather is local on
+            # every device; the constraint re-shards the minibatch along
+            # the batch axis (slice-keeping, no collective) so the rest of
+            # the step runs data-parallel exactly like the host-fed path.
+            from distributedtensorflowexample_tpu.parallel.mesh import (
+                batch_sharding)
+            batch = jax.lax.with_sharding_constraint(batch,
+                                                     batch_sharding(mesh))
+        return batch
+
+    return gather
+
+
+def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
+                   mesh=None, num_replicas: int = 1,
+                   replicas_to_aggregate: int = 0) -> Callable:
     """The un-jitted (state, batch) -> (state, metrics) step body, shared
     by the plain and the device-resident (indexed) step factories.
 
@@ -39,23 +108,36 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
     per-shard under ``jax.shard_map`` over the batch axis; the batch mean
     outside it remains an ordinary jnp op, keeping the gradient psum
     identical to the XLA path.
-    """
-    if ce_impl not in ("xla", "pallas"):
-        raise ValueError(f"unknown ce_impl {ce_impl!r}")
 
-    def compute_loss(logits, labels):
-        if ce_impl == "xla":
-            return softmax_cross_entropy(logits, labels, label_smoothing)
-        from distributedtensorflowexample_tpu.ops.pallas import (
-            fused_softmax_cross_entropy_rows)
-        fused = lambda l, y: fused_softmax_cross_entropy_rows(
-            l, y, label_smoothing)
-        if mesh is not None and mesh.size > 1:
-            from jax.sharding import PartitionSpec as P
-            fused = jax.shard_map(fused, mesh=mesh,
-                                  in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-                                  out_specs=P(DATA_AXIS), check_vma=False)
-        return jnp.mean(fused(logits, labels))
+    ``replicas_to_aggregate=R`` (with ``0 < R < num_replicas``) implements
+    SyncReplicasOptimizer's partial aggregation: each step only R of the N
+    replicas' gradients enter the update.  The reference aggregated the
+    first R gradients to *arrive* (backup workers absorbing stragglers —
+    a race); lockstep SPMD has no stragglers to drop, so the TPU-native
+    analog selects a deterministic rotating subset — replica ``i``
+    contributes at step ``s`` iff ``(i - s) mod N < R`` — which preserves
+    the statistical semantics (each step averages R replica gradients;
+    every replica contributes equally over any N consecutive steps).
+    Implemented as a per-row weight on the loss, so the gradient psum
+    stays the one XLA collective; unselected replicas' rows carry zero
+    weight and their gradient contribution vanishes.
+    """
+    R, N = int(replicas_to_aggregate), max(1, int(num_replicas))
+    if not 0 <= R <= N:
+        raise ValueError(
+            f"replicas_to_aggregate {R} must be in [0, {N}] (0 = all)")
+    partial_agg = 0 < R < N
+    loss_rows = make_loss_rows(label_smoothing, ce_impl, mesh)
+
+    def compute_loss(logits, labels, step):
+        rows = loss_rows(logits, labels)
+        if not partial_agg:
+            return jnp.mean(rows)
+        batch = logits.shape[0]
+        per_shard = batch // N
+        replica_of_row = jnp.arange(batch, dtype=jnp.int32) // per_shard
+        selected = ((replica_of_row - step) % N) < R
+        return jnp.sum(rows * selected.astype(rows.dtype)) / (R * per_shard)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -73,7 +155,7 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
                 logits = state.apply_fn(variables, batch["image"], train=True,
                                         rngs={"dropout": step_rng})
                 new_stats = state.batch_stats
-            loss = compute_loss(logits, batch["label"])
+            loss = compute_loss(logits, batch["label"], state.step)
             return loss, (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
@@ -91,9 +173,11 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
 
 
 def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
-                    mesh=None) -> Callable:
+                    mesh=None, num_replicas: int = 1,
+                    replicas_to_aggregate: int = 0) -> Callable:
     """Build the jitted (state, batch) -> (state, metrics) step."""
-    return jax.jit(_build_step_fn(label_smoothing, ce_impl, mesh),
+    return jax.jit(_build_step_fn(label_smoothing, ce_impl, mesh,
+                                  num_replicas, replicas_to_aggregate),
                    donate_argnums=0)
 
 
@@ -101,17 +185,18 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             label_smoothing: float = 0.0,
                             ce_impl: str = "xla", mesh=None,
                             unroll_steps: int = 1,
-                            augment: str = "none") -> Callable:
+                            augment: str = "none", num_replicas: int = 1,
+                            replicas_to_aggregate: int = 0) -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
-    receives ``{"images", "labels", "perm"}`` (full arrays + this epoch's
-    shuffled index order) and slices its minibatch out of ``perm`` at the
-    position derived from ``state.step`` — so the host transfers nothing
-    per step.  This is the TPU-native kill for the feed_dict/H2D per-step
-    copy (SURVEY.md §3a, §7 "hard parts"): at MNIST-sized step times the
-    transfer IS the bottleneck (measured ~1.4 ms vs a ~0.07 ms step on a
-    v5e chip through the host tunnel).
+    receives ``{"images", "labels", "perm"}`` (full arrays + a two-slot
+    epoch permutation pair) and slices its minibatch out of the right
+    perm row at the position derived from ``state.step`` — so the host
+    transfers nothing per step.  This is the TPU-native kill for the
+    feed_dict/H2D per-step copy (SURVEY.md §3a, §7 "hard parts"): at
+    MNIST-sized step times the transfer IS the bottleneck (measured
+    ~1.4 ms vs a ~0.07 ms step on a v5e chip through the host tunnel).
 
     Semantics match the host Batcher exactly: shuffled epochs without
     replacement, batch_size rows per step, global step drives the epoch
@@ -122,49 +207,23 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     math, the global step advances by K), one host dispatch.  When the
     device is reached through a high-latency link the dispatch round-trip
     dominates MNIST-sized steps, and this divides it by K — the TPU-native
-    analog of Keras ``steps_per_execution``.  Requires
-    ``steps_per_epoch % K == 0`` so a scan window never crosses an epoch
-    boundary (the host swaps the permutation between calls); returned
+    analog of Keras ``steps_per_execution``.  Each scanned sub-step picks
+    its epoch's perm slot (``(step // steps_per_epoch) & 1``) so a window
+    may cross one epoch boundary; any ``K <= steps_per_epoch`` works (pass
+    the same value as DeviceDataset's ``steps_per_next``); returned
     metrics are the mean over the K updates.
     """
-    if unroll_steps < 1 or (unroll_steps & (unroll_steps - 1)):
+    if not 1 <= unroll_steps <= steps_per_epoch:
         raise ValueError(
-            f"unroll_steps must be a power of two >= 1, got {unroll_steps}")
-    if steps_per_epoch % unroll_steps:
-        raise ValueError(
-            f"unroll_steps {unroll_steps} must divide steps_per_epoch "
-            f"{steps_per_epoch} — pass the same value as DeviceDataset's "
-            f"steps_per_next (see DeviceDataset.epoch_multiple)")
-    if augment not in ("none", "cifar"):
-        raise ValueError(f"unknown augment {augment!r}")
-    inner = _build_step_fn(label_smoothing, ce_impl, mesh)
+            f"unroll_steps {unroll_steps} must be in [1, steps_per_epoch="
+            f"{steps_per_epoch}] (a fused window may cross at most one "
+            f"epoch boundary)")
+    inner = _build_step_fn(label_smoothing, ce_impl, mesh, num_replicas,
+                           replicas_to_aggregate)
+    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
-        # In-epoch position from the global step; modulo first so the
-        # int32 product can't overflow on long runs.
-        pos = (state.step % steps_per_epoch) * batch_size
-        idx = jax.lax.dynamic_slice(data["perm"], (pos,), (batch_size,))
-        batch = {"image": jnp.take(data["images"], idx, axis=0),
-                 "label": jnp.take(data["labels"], idx, axis=0)}
-        if augment == "cifar":
-            # On-device crop/flip (data/augment_device.py): a dedicated
-            # stream folded from the state rng — disjoint from the
-            # dropout stream, which folds in only the step.
-            from distributedtensorflowexample_tpu.data.augment_device import (
-                cifar_augment_device)
-            akey = jax.random.fold_in(
-                jax.random.fold_in(state.rng, 0x5EED), state.step)
-            batch["image"] = cifar_augment_device(batch["image"], akey)
-        if mesh is not None and mesh.size > 1:
-            # Dataset + perm are replicated, so the gather is local on
-            # every device; the constraint re-shards the minibatch along
-            # the batch axis (slice-keeping, no collective) so the rest of
-            # the step runs data-parallel exactly like the host-fed path.
-            from distributedtensorflowexample_tpu.parallel.mesh import (
-                batch_sharding)
-            batch = jax.lax.with_sharding_constraint(batch,
-                                                     batch_sharding(mesh))
-        return inner(state, batch)
+        return inner(state, gather(state.step, state.rng, data))
 
     if unroll_steps == 1:
         return jax.jit(one, donate_argnums=0)
@@ -204,12 +263,74 @@ def make_eval_step() -> Callable:
     return _EVAL_STEP
 
 
+def make_resident_eval(images, labels, batch_size: int = 1000,
+                       mesh=None) -> Callable:
+    """Device-resident exact-accuracy eval: ONE dispatch per eval.
+
+    The host-fed ``evaluate`` re-uploads the split 1000 rows at a time on
+    every call — through a high-latency link that wall time pollutes the
+    training window.  The test split fits in HBM exactly like the train
+    split does, so this uploads it once (padded to a whole number of
+    batches, pad labels -1 so they never match an argmax), shards each
+    batch row-wise over the mesh, and jits a ``lax.scan`` over the batches
+    — the whole eval is a single compiled call returning one scalar.
+
+    Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
+    """
+    import numpy as np
+
+    n = len(labels)
+    if mesh is not None and batch_size % mesh.size:
+        raise ValueError(f"eval batch {batch_size} must divide across "
+                         f"{mesh.size} devices")
+    num_batches = -(-n // batch_size)
+    pad = num_batches * batch_size - n
+    if pad:
+        images = np.concatenate(
+            [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+        labels = np.concatenate([labels, np.full((pad,), -1, labels.dtype)])
+    xs = np.ascontiguousarray(
+        images.reshape((num_batches, batch_size) + images.shape[1:]))
+    ys = np.ascontiguousarray(labels.reshape(num_batches, batch_size))
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shard = NamedSharding(mesh, P(None, DATA_AXIS))
+        if jax.process_count() > 1:
+            put = lambda a: jax.make_array_from_process_local_data(shard, a)
+        else:
+            put = lambda a: jax.device_put(a, shard)
+    else:
+        put = jax.device_put
+    xs, ys = put(xs), put(ys)
+
+    @jax.jit
+    def run(state: TrainState, xs, ys):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+
+        def body(total, xy):
+            bx, by = xy
+            logits = state.apply_fn(variables, bx, train=False)
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=-1) == by).astype(jnp.int32))
+            return total + correct, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32), (xs, ys))
+        return total
+
+    return lambda state: int(run(state, xs, ys)) / n
+
+
 def evaluate(state: TrainState, images, labels, batch_size: int = 1000,
              sharding=None) -> float:
     """Exact accuracy over a full split, batched to bound HBM use.
 
     Every process holds the full split (the reference's eval behavior);
     under multi-host the batch helper keeps only locally-owned rows.
+    Host-fed — see ``make_resident_eval`` for the device-resident path
+    the trainers use by default.
     """
     eval_step = make_eval_step()
     n = len(labels)

@@ -23,9 +23,10 @@ from distributedtensorflowexample_tpu.models import build_model
 from distributedtensorflowexample_tpu.parallel import (
     batch_sharding, make_mesh, replicated_sharding)
 from distributedtensorflowexample_tpu.parallel.async_ps import (
-    consolidate, make_async_train_step, make_worker_state)
+    consolidate, make_async_train_step, make_indexed_async_train_step,
+    make_worker_state)
 from distributedtensorflowexample_tpu.parallel.sync import (
-    evaluate, make_indexed_train_step, make_train_step)
+    evaluate, make_indexed_train_step, make_resident_eval, make_train_step)
 from distributedtensorflowexample_tpu.training.checkpoint import CheckpointManager
 from distributedtensorflowexample_tpu.training.hooks import (
     CheckpointHook, EvalHook)
@@ -49,11 +50,13 @@ def _load_dataset(cfg: RunConfig, name: str, split: str):
 def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                  augment: bool = False) -> dict:
     """Train per config; returns a summary dict (used by tests and bench)."""
-    if cfg.sync_mode == "async" and cfg.pallas_ce:
-        # The async step vmaps over virtual workers; the Pallas loss head
-        # is only wired into the sync step. Fail fast (pure-cfg check)
-        # rather than let a benchmark silently measure the XLA path.
-        raise ValueError("--pallas_ce is not supported with sync_mode=async")
+    if cfg.sync_mode == "async" and cfg.fused_optimizer:
+        # The async step vmaps the optimizer apply over virtual workers; a
+        # pallas_call has no batching rule XLA can partition over the
+        # worker-sharded axis. (The Pallas CE head IS supported in async —
+        # it runs on the flattened batch outside the vmap.)
+        raise ValueError(
+            "--fused_optimizer is not supported with sync_mode=async")
     info = cluster.resolve(cfg)
     if info.role == "ps":
         print(cluster.PS_NOTICE, flush=True)
@@ -74,15 +77,11 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     # Device-resident input path (data/device_dataset.py): the split lives
     # in HBM and batches are gathered on device — no per-step H2D copy.
-    # "auto" uses it whenever the step can consume it (sync mode;
-    # augmentation runs on device, data/augment_device.py).
+    # "auto" (the default) uses it in both sync and async modes;
+    # augmentation runs on device (data/augment_device.py).
     if cfg.device_data not in ("auto", "on", "off"):
         raise ValueError(f"unknown device_data {cfg.device_data!r}")
-    if cfg.device_data == "on" and cfg.sync_mode == "async":
-        raise ValueError("--device_data=on requires sync mode (use off/auto)")
-    use_device_data = (cfg.device_data == "on"
-                       or (cfg.device_data == "auto"
-                           and cfg.sync_mode == "sync"))
+    use_device_data = cfg.device_data != "off"
     if not use_device_data:
         batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
                           process_index=jax.process_index(),
@@ -99,6 +98,10 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     if cfg.sync_mode not in ("sync", "async"):
         raise ValueError(f"unknown sync_mode {cfg.sync_mode!r}")
     is_async = cfg.sync_mode == "async"
+    if is_async and cfg.replicas_to_aggregate:
+        raise ValueError(
+            "--replicas_to_aggregate is a SyncReplicasOptimizer (sync-mode) "
+            "concept; async mode has no aggregation barrier to relax")
     if is_async:
         # Local-SGD emulation of the reference's async-PS staleness: one
         # virtual worker per device, averaged every --async_period steps.
@@ -111,8 +114,18 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     manager = None
     if cfg.checkpoint_every > 0 or cfg.resume:
         manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
-                                    max_to_keep=cfg.keep_checkpoints)
+                                    max_to_keep=cfg.keep_checkpoints,
+                                    run_metadata={"sync_mode": cfg.sync_mode})
         if cfg.resume and manager.latest_step() is not None:
+            saved = manager.saved_run_metadata()
+            if saved and saved.get("sync_mode", cfg.sync_mode) != cfg.sync_mode:
+                raise ValueError(
+                    f"checkpoint in {cfg.log_dir}/checkpoints was written by "
+                    f"a sync_mode={saved['sync_mode']!r} run; restoring it "
+                    f"into sync_mode={cfg.sync_mode!r} would mismatch the "
+                    f"state layout (worker-tiled vs replicated). Use a fresh "
+                    f"--log_dir or rerun with --sync_mode="
+                    f"{saved['sync_mode']}")
             state = manager.restore(state)
             if is_chief:
                 print(f"resumed from checkpoint at step {int(state.step)}",
@@ -123,8 +136,15 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # Eval batch must divide across the mesh like the train batch does.
     eval_batch = max(global_batch,
                      (1000 // num_replicas) * num_replicas or num_replicas)
-    _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
-                                  batch_size=eval_batch, sharding=data_shard)
+    if use_device_data:
+        # Test split resident in HBM too: one dispatch per eval, and eval
+        # wall time stops polluting the training window.
+        _evaluate = make_resident_eval(test_x, test_y, batch_size=eval_batch,
+                                       mesh=mesh)
+    else:
+        _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
+                                      batch_size=eval_batch,
+                                      sharding=data_shard)
     # Async state carries per-worker copies; eval on their average.
     eval_fn = (lambda s: _evaluate(consolidate(s))) if is_async else _evaluate
     if cfg.eval_every > 0:
@@ -134,43 +154,48 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                                   cfg.profile_num_steps))
 
     ce_impl = "pallas" if cfg.pallas_ce else "xla"
+    device_augment = "cifar" if augment else "none"
     steps_per_call = 1
-    if is_async:
-        if cfg.steps_per_loop > 1:
-            raise ValueError("--steps_per_loop > 1 is not supported with "
-                             "sync_mode=async")
-        train_step = make_async_train_step(num_replicas, cfg.async_period,
-                                           cfg.label_smoothing)
-    elif use_device_data:
+    ds = None
+    if use_device_data:
         steps_per_call = max(1, cfg.steps_per_loop)
-        if cfg.train_steps % steps_per_call:
+        remaining = cfg.train_steps - int(state.step)
+        if remaining > 0 and remaining % steps_per_call:
+            # The loop advances in steps_per_call strides; a non-multiple
+            # remainder would silently under-run the target step count.
             raise ValueError(
-                f"--train_steps {cfg.train_steps} must be a multiple of "
+                f"remaining steps {remaining} (train_steps {cfg.train_steps}"
+                f" - resumed step {int(state.step)}) must be a multiple of "
                 f"--steps_per_loop {steps_per_call}")
-        if int(state.step) % steps_per_call:
-            # An unaligned resume would drop tail steps AND let a scan
-            # window straddle an epoch boundary (DeviceDataset only swaps
-            # the permutation between calls).
-            raise ValueError(
-                f"resumed step {int(state.step)} is not a multiple of "
-                f"--steps_per_loop {steps_per_call}; resume with the "
-                f"steps_per_loop the checkpoint was written under")
-        # Constructed after a possible resume so epoch boundaries line up
-        # with the restored global step.
+        # Constructed after a possible resume so epoch slots line up with
+        # the restored global step.
         ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh,
                            seed=cfg.seed, start_step=int(state.step),
                            steps_per_next=steps_per_call)
         batches = ds
+    elif cfg.steps_per_loop > 1:
+        raise ValueError("--steps_per_loop > 1 requires the "
+                         "device-resident input path (device_data)")
+
+    if is_async and use_device_data:
+        train_step = make_indexed_async_train_step(
+            num_replicas, cfg.async_period, global_batch, ds.steps_per_epoch,
+            cfg.label_smoothing, ce_impl=ce_impl, mesh=mesh,
+            unroll_steps=steps_per_call, augment=device_augment)
+    elif is_async:
+        train_step = make_async_train_step(num_replicas, cfg.async_period,
+                                           cfg.label_smoothing,
+                                           ce_impl=ce_impl, mesh=mesh)
+    elif use_device_data:
         train_step = make_indexed_train_step(
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
             ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call,
-            augment="cifar" if augment else "none")
+            augment=device_augment, num_replicas=num_replicas,
+            replicas_to_aggregate=cfg.replicas_to_aggregate)
     else:
-        if cfg.steps_per_loop > 1:
-            raise ValueError("--steps_per_loop > 1 requires the "
-                             "device-resident input path (device_data)")
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
-                                     mesh=mesh)
+                                     mesh=mesh, num_replicas=num_replicas,
+                                     replicas_to_aggregate=cfg.replicas_to_aggregate)
     with mesh:
         loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger,
                          steps_per_call=steps_per_call)

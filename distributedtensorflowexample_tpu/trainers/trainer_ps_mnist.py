@@ -7,10 +7,12 @@ to PS tasks, each worker stepping asynchronously against shared variables
 
 Rebuild (SURVEY.md §7 step 6): there are no PS processes — ``--job_name=ps``
 exits with a notice; the full ClusterSpec CLI is accepted as compatibility
-aliases.  By default the workload runs on the deterministic sync-SPMD path
-(documented semantic change).  ``--sync_mode=async`` opts into local-SGD
-emulation of async staleness: per-replica parameter copies step
-independently and average every ``--async_period`` steps.
+aliases.  The workload defaults to ``--sync_mode=async``: a local-SGD
+emulation of async staleness in which per-replica parameter copies step
+independently and average every ``--async_period`` steps (bounded,
+deterministic staleness replacing the reference's unbounded PS write
+races).  ``--sync_mode=sync`` opts into the deterministic sync-SPMD path,
+making this entrypoint equivalent to config 3.
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ from distributedtensorflowexample_tpu.trainers.common import run_training
 def main(argv=None) -> dict:
     cfg = parse_flags(argv, description=__doc__,
                       batch_size=64, train_steps=2000, learning_rate=0.05,
-                      momentum=0.9, dataset="mnist", sync_mode="sync")
+                      momentum=0.9, dataset="mnist", sync_mode="async")
     return run_training(cfg, model_name="mnist_cnn", dataset_name="mnist")
 
 

@@ -9,6 +9,7 @@ async saves (training does not stall on serialization).
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
@@ -27,7 +28,11 @@ def _saveable(state: TrainState) -> dict[str, Any]:
 
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, run_metadata: dict | None = None):
+        """``run_metadata``: small JSON-able facts about the writing run
+        (e.g. ``sync_mode``) persisted next to the checkpoints so a later
+        run can refuse a structurally-incompatible restore with a clear
+        error instead of a shape mismatch deep inside Orbax."""
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -35,14 +40,40 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save))
+        self._run_metadata = run_metadata
 
     def save(self, step: int, state: TrainState, force: bool = False) -> bool:
         step = int(step)
         if step in self._mgr.all_steps():
             return False  # periodic save already covered this step
+        self._write_run_metadata()
         return self._mgr.save(step,
                               args=ocp.args.StandardSave(_saveable(state)),
                               force=force)
+
+    def _write_run_metadata(self) -> None:
+        """Keep the metadata describing the CURRENT writer: a reused
+        directory whose new (non-resumed) run differs must overwrite, or a
+        later resume of the new checkpoints would be wrongly refused."""
+        if self._run_metadata is None:
+            return
+        path = os.path.join(self._dir, "run_metadata.json")
+        if self.saved_run_metadata() == self._run_metadata:
+            return
+        if jax.process_index() == 0:  # chief-only, atomic via rename
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._run_metadata, f)
+            os.replace(tmp, path)
+
+    def saved_run_metadata(self) -> dict | None:
+        """Metadata of the run that wrote this directory (None if absent —
+        e.g. a checkpoint written before metadata existed)."""
+        path = os.path.join(self._dir, "run_metadata.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

@@ -37,3 +37,14 @@ jax.config.update("jax_cpu_enable_async_dispatch", False)
 @pytest.fixture()
 def tmp_log_dir(tmp_path):
     return str(tmp_path / "logs")
+
+
+@pytest.fixture()
+def small_synthetic(monkeypatch):
+    """Shrink the synthetic fallback splits: the device-resident path
+    replicates the whole split per virtual device, and full-size programs
+    on the 1-core CI host stretch XLA:CPU's 8-thread collective rendezvous
+    past its hard timeout (flaky aborts).  Semantics under test don't
+    depend on split size."""
+    from distributedtensorflowexample_tpu.data import mnist
+    monkeypatch.setattr(mnist, "_SYNTH_SIZES", {"train": 2048, "test": 512})

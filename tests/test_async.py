@@ -10,7 +10,8 @@ from distributedtensorflowexample_tpu.models import build_model
 from distributedtensorflowexample_tpu.parallel import (
     batch_sharding, make_mesh, replicated_sharding)
 from distributedtensorflowexample_tpu.parallel.async_ps import (
-    consolidate, make_async_train_step, make_worker_state)
+    consolidate, make_async_train_step, make_indexed_async_train_step,
+    make_worker_state)
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
@@ -81,13 +82,79 @@ def test_async_converges_and_consolidates():
     assert leaf.ndim == jax.tree.leaves(state.params)[0].ndim - 1
 
 
-def test_async_trainer_end_to_end(tmp_path):
+def test_async_trainer_end_to_end(tmp_path, small_synthetic):
+    """trainer_ps_mnist's default path: async local-SGD over the
+    device-resident dataset (config 2 out of the box)."""
     from distributedtensorflowexample_tpu.trainers import trainer_ps_mnist
     summary = trainer_ps_mnist.main(
-        ["--sync_mode", "async", "--async_period", "4",
+        ["--async_period", "4",
          "--train_steps", "30", "--batch_size", "8",
          "--log_dir", str(tmp_path), "--data_dir", "/nonexistent",
          "--resume", "false", "--log_every", "10",
          "--learning_rate", "0.02"])
     assert summary["steps"] == 30
     assert np.isfinite(summary["final_accuracy"])
+
+
+def test_indexed_async_unrolled_matches_stepwise():
+    """Device-resident async: K fused updates == K separate updates
+    bit-for-bit, across an epoch boundary and an averaging boundary."""
+    from distributedtensorflowexample_tpu.data import DeviceDataset
+
+    mesh = make_mesh()
+    x, y = make_synthetic(384, (28, 28, 1), 10, seed=1)  # 6 steps/epoch @64
+    b, K, total, period = 64, 4, 12, 3
+    ds1 = DeviceDataset(x, y, b, mesh=mesh, seed=6)
+    dsK = DeviceDataset(x, y, b, mesh=mesh, seed=6, steps_per_next=K)
+    s1, sK = _tiled_state(mesh, lr=0.1, seed=2), _tiled_state(mesh, lr=0.1,
+                                                              seed=2)
+    one = make_indexed_async_train_step(mesh.size, period, b, 6, mesh=mesh)
+    fused = make_indexed_async_train_step(mesh.size, period, b, 6, mesh=mesh,
+                                          unroll_steps=K)
+    with mesh:
+        for _ in range(total):
+            s1, _ = one(s1, next(ds1))
+        for _ in range(total // K):
+            sK, _ = fused(sK, next(dsK))
+    assert int(s1.step) == int(sK.step) == total
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s1.params, sK.params)
+
+
+def test_async_pallas_ce_matches_xla():
+    """The Pallas loss head under async (flattened-batch shard_map) is
+    numerically equivalent to the XLA head."""
+    mesh = make_mesh()
+    batch = _batch(mesh, 64, sample_seed=3)
+    s_x, s_p = _tiled_state(mesh, lr=0.2, seed=4), _tiled_state(mesh, lr=0.2,
+                                                                seed=4)
+    step_x = make_async_train_step(mesh.size, period=2, ce_impl="xla",
+                                   mesh=mesh)
+    step_p = make_async_train_step(mesh.size, period=2, ce_impl="pallas",
+                                   mesh=mesh)
+    with mesh:
+        s_x, m_x = step_x(s_x, batch)
+        s_p, m_p = step_p(s_p, batch)
+    np.testing.assert_allclose(float(m_x["loss"]), float(m_p["loss"]),
+                               rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                         atol=1e-6),
+                 s_x.params, s_p.params)
+
+
+def test_run_training_async_device_data_steps_per_loop(tmp_path,
+                                                       small_synthetic):
+    """The three round-1 fences are gone: async + device_data +
+    steps_per_loop + pallas_ce compose in one run."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    out = run_training(
+        RunConfig(sync_mode="async", async_period=4, steps_per_loop=4,
+                  device_data="on", pallas_ce=True, train_steps=24,
+                  batch_size=64, global_batch=True, learning_rate=0.3,
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  dataset="mnist", log_every=8, seed=1, resume=False),
+        "softmax", "mnist")
+    assert out["steps"] == 24
+    assert np.isfinite(out["final_accuracy"])

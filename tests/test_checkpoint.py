@@ -160,3 +160,31 @@ def test_restore_preserves_sharding(tmp_path):
     assert w.sharding.is_equivalent_to(repl, w.ndim)
     assert _trees_equal(restored.params, state.params)
     mgr.close()
+
+
+def test_run_metadata_roundtrip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, run_metadata={"sync_mode": "sync"})
+    assert mgr.saved_run_metadata() is None      # nothing saved yet
+    mgr.save(1, _fresh_state(), force=True)
+    mgr.wait()
+    assert mgr.saved_run_metadata() == {"sync_mode": "sync"}
+    # A second manager over the same dir reads the original writer's mode.
+    again = CheckpointManager(d, run_metadata={"sync_mode": "async"})
+    assert again.saved_run_metadata() == {"sync_mode": "sync"}
+
+
+def test_cross_mode_restore_is_refused(tmp_path, small_synthetic):
+    """A sync-run checkpoint restored into an async run must fail with a
+    clear error naming the saved mode, not an Orbax shape mismatch."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  log_every=50, seed=1)
+    run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,
+                           **common), "softmax", "mnist")
+    with pytest.raises(ValueError, match="sync_mode='sync'"):
+        run_training(RunConfig(train_steps=8, resume=True, sync_mode="async",
+                               **common), "softmax", "mnist")

@@ -30,16 +30,19 @@ def test_epoch_is_permutation_without_replacement():
     mesh = make_mesh()
     ds = DeviceDataset(x, y, 64, mesh=mesh, seed=3)
     assert ds.steps_per_epoch == 520 // 64
-    data = next(ds)
-    perm = np.asarray(data["perm"])
-    assert len(perm) == ds.epoch_len
-    assert len(np.unique(perm)) == ds.epoch_len        # no replacement
-    # Perm persists within the epoch, changes at the boundary.
+    pair = np.asarray(next(ds)["perm"])
+    assert pair.shape == (2, ds.epoch_len)
+    for row in pair:                                   # no replacement
+        assert len(np.unique(row)) == ds.epoch_len
+    assert not np.array_equal(pair[0], pair[1])        # distinct epochs
+    # The pair persists within the epoch; at the boundary the stale slot
+    # (epoch 0's row) is replaced by epoch 2's perm, epoch 1's row stays.
     for _ in range(ds.steps_per_epoch - 1):
-        np.testing.assert_array_equal(np.asarray(next(ds)["perm"]), perm)
-    perm2 = np.asarray(next(ds)["perm"])
-    assert not np.array_equal(perm2, perm)
-    assert len(np.unique(perm2)) == ds.epoch_len
+        np.testing.assert_array_equal(np.asarray(next(ds)["perm"]), pair)
+    pair2 = np.asarray(next(ds)["perm"])
+    np.testing.assert_array_equal(pair2[1], pair[1])
+    assert not np.array_equal(pair2[0], pair[0])
+    assert len(np.unique(pair2[0])) == ds.epoch_len
 
 
 def test_start_step_alignment_matches_fresh_run():
@@ -71,7 +74,7 @@ def test_indexed_step_consumes_each_epoch_row_once():
     for i in range(ds.steps_per_epoch):
         data = next(ds)
         pos = (i % ds.steps_per_epoch) * b
-        idx = np.asarray(data["perm"])[pos:pos + b]
+        idx = np.asarray(data["perm"])[0, pos:pos + b]   # epoch 0 -> slot 0
         seen.extend(np.asarray(y)[idx].tolist())
     assert sorted(seen) == list(range(n))
 
@@ -89,7 +92,7 @@ def test_indexed_step_gather_matches_host_batch():
         replicated_sharding(mesh))
     s_idx, s_ref = make_state(), make_state()
     data = next(ds)
-    perm = np.asarray(data["perm"])
+    perm = np.asarray(data["perm"])[0]                  # epoch 0 -> slot 0
     host_batch = {"image": jnp.asarray(x[perm[:b]]),
                   "label": jnp.asarray(y[perm[:b]])}
     with mesh:
@@ -126,23 +129,12 @@ def test_device_data_flag_validation(tmp_path, small_synthetic):
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
-    cfg = RunConfig(device_data="on", sync_mode="async", train_steps=1,
+    cfg = RunConfig(device_data="bogus", train_steps=1,
                     batch_size=64, global_batch=True,
                     data_dir=str(tmp_path), log_dir=str(tmp_path / "l"),
                     resume=False)
     with pytest.raises(ValueError, match="device_data"):
         run_training(cfg, "softmax", "mnist")
-
-
-@pytest.fixture()
-def small_synthetic(monkeypatch):
-    """Shrink the synthetic fallback splits: the device-resident path
-    replicates the whole split per virtual device, and full-size programs
-    on the 1-core CI host stretch XLA:CPU's 8-thread collective rendezvous
-    past its hard timeout (flaky aborts).  Semantics under test don't
-    depend on split size."""
-    from distributedtensorflowexample_tpu.data import mnist
-    monkeypatch.setattr(mnist, "_SYNTH_SIZES", {"train": 2048, "test": 512})
 
 
 def test_run_training_device_data_end_to_end(tmp_path, small_synthetic):
@@ -206,22 +198,48 @@ def test_run_training_steps_per_loop(tmp_path, small_synthetic):
                      "softmax", "mnist")
 
 
-def test_epoch_multiple_bounds_drop():
-    """The truncation granule is spn-independent, a power of two, and never
-    drops more than 1/16 of an epoch's batches."""
-    for raw in (1, 4, 8, 9, 31, 33, 48, 63, 71, 234, 937, 4096):
-        m = DeviceDataset.epoch_multiple(raw)
-        assert m & (m - 1) == 0 and 1 <= m <= 32
-        dropped = raw % m
-        assert dropped * 16 <= raw, (raw, m, dropped)
-    # The review's worst case: 48 raw steps must not truncate to 32.
-    assert DeviceDataset.epoch_multiple(48) == 16
-
-
-def test_unshuffled_truncation_warns():
-    # raw 33 steps at batch 64: granule 32 (drop 1/33 ≤ 1/16), so one step
-    # is truncated — with shuffle=False those rows are never visited.
-    x, y = _data(n=33 * 64)
+def test_unrolled_step_across_epoch_boundary_matches_stepwise():
+    """A fused window that straddles an epoch boundary (spe=6, K=4: the
+    window [4,8) crosses at step 6) must match the stepwise run bitwise —
+    the slot-select gather reads the new epoch's perm mid-scan."""
     mesh = make_mesh()
-    with pytest.warns(UserWarning, match="never be seen"):
-        DeviceDataset(x, y, 64, mesh=mesh, shuffle=False)
+    x, y = _data(384)
+    b, K, total = 64, 4, 12
+    ds1 = DeviceDataset(x, y, b, mesh=mesh, seed=9)
+    dsK = DeviceDataset(x, y, b, mesh=mesh, seed=9, steps_per_next=K)
+    assert ds1.steps_per_epoch == 6 and total % K == 0
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    s1, sK = make_state(), make_state()
+    one = make_indexed_train_step(b, 6)
+    fused = make_indexed_train_step(b, 6, unroll_steps=K)
+    with mesh:
+        for _ in range(total):
+            s1, _ = one(s1, next(ds1))
+        for _ in range(total // K):
+            sK, _ = fused(sK, next(dsK))
+    assert int(s1.step) == int(sK.step) == total        # 2 epochs crossed
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s1.params, sK.params)
+
+
+def test_no_truncation_and_unshuffled_order():
+    """Epochs keep every whole batch (only the sub-batch remainder drops,
+    matching the host Batcher) and shuffle=False yields identity order."""
+    x, y = _data(n=33 * 64 + 17)
+    mesh = make_mesh()
+    ds = DeviceDataset(x, y, 64, mesh=mesh, shuffle=False)
+    assert ds.steps_per_epoch == 33
+    pair = np.asarray(next(ds)["perm"])
+    np.testing.assert_array_equal(pair[0], np.arange(33 * 64))
+
+
+def test_steps_per_next_bounds():
+    x, y = _data(384)   # 6 steps/epoch at batch 64
+    mesh = make_mesh()
+    DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=6)
+    with pytest.raises(ValueError, match="steps_per_next"):
+        DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=7)
+    with pytest.raises(ValueError, match="steps_per_next"):
+        DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=0)

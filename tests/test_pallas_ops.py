@@ -142,6 +142,31 @@ def test_pallas_step_matches_xla_step_on_mesh():
                  s_ref.params, s_pal.params)
 
 
+def test_fused_sgd_is_one_kernel_launch():
+    """The whole parameter set updates in ONE pallas_call (round 1 launched
+    one per leaf — ~65 for ResNet-20), with the momentum trace stored as a
+    single flat (rows, 128) buffer."""
+    from distributedtensorflowexample_tpu.ops.pallas import fused_momentum_sgd
+
+    tx = fused_momentum_sgd(0.1, momentum=0.9)
+    params = _tree()
+    state = tx.init(params)
+    assert state.trace.ndim == 2 and state.trace.shape[1] == 128
+
+    jaxpr = jax.make_jaxpr(
+        lambda g, s, p: tx.update(g, s, p))(_tree(), state, params)
+    assert str(jaxpr).count("pallas_call") == 1
+
+    # Zero-momentum first step == plain SGD update.
+    grads = _tree()
+    updates, state2 = tx.update(grads, state, params)
+    jax.tree.map(lambda u, g: np.testing.assert_allclose(u, -0.1 * g,
+                                                         rtol=1e-6,
+                                                         atol=1e-7),
+                 updates, grads)
+    assert int(state2.count) == 1
+
+
 def test_fused_optimizer_flag_rejects_incompatible_config():
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.training.optimizers import (
@@ -154,13 +179,16 @@ def test_fused_optimizer_flag_rejects_incompatible_config():
                                   weight_decay=1e-4))
 
 
-def test_pallas_ce_rejected_in_async_mode(tmp_path):
+def test_fused_optimizer_rejected_in_async_mode(tmp_path):
+    """The Pallas CE head works under async (tests/test_async.py); the
+    fused optimizer apply cannot (pallas under the worker vmap) and must
+    fail fast with a clear error."""
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
-    cfg = RunConfig(sync_mode="async", pallas_ce=True, train_steps=1,
-                    batch_size=64, global_batch=True, dataset="mnist",
-                    data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
-                    resume=False)
-    with pytest.raises(ValueError, match="pallas_ce"):
+    cfg = RunConfig(sync_mode="async", fused_optimizer=True, momentum=0.9,
+                    train_steps=1, batch_size=64, global_batch=True,
+                    dataset="mnist", data_dir=str(tmp_path),
+                    log_dir=str(tmp_path / "logs"), resume=False)
+    with pytest.raises(ValueError, match="fused_optimizer"):
         run_training(cfg, "softmax", "mnist")
