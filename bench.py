@@ -97,10 +97,6 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
     load = load_mnist if dataset == "mnist" else load_cifar10
     sample = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
     train_x, train_y = load("/tmp/data", "train")
-    # A fused window cannot exceed an epoch; on multi-chip meshes the
-    # growing global batch shrinks steps_per_epoch below the requested
-    # unroll constants.
-    unroll = min(unroll, len(train_y) // global_batch)
     ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh, seed=0,
                        steps_per_next=unroll)
 
@@ -118,12 +114,14 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
     if sync:
         step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
                                        mesh=mesh, unroll_steps=unroll,
-                                       ce_impl=ce_impl, augment=augment)
+                                       ce_impl=ce_impl, augment=augment,
+                                       num_slots=ds.num_slots)
     else:
         state = make_worker_state(state, num_chips, mesh)
         step = make_indexed_async_train_step(
             num_chips, async_period, global_batch, ds.steps_per_epoch,
-            ce_impl=ce_impl, mesh=mesh, unroll_steps=unroll, augment=augment)
+            ce_impl=ce_impl, mesh=mesh, unroll_steps=unroll, augment=augment,
+            num_slots=ds.num_slots)
     return step, ds, state, unroll
 
 
@@ -178,14 +176,26 @@ def _flops_per_step(step, state, data, unroll: int) -> float | None:
 
 
 def main() -> None:
+    """Each workload is fault-isolated: one failing config (e.g. the
+    tunnel dropping mid-run) must not stop the later lines — above all
+    the HEADLINE, which is always the last line emitted."""
+    import traceback
+
     from distributedtensorflowexample_tpu.parallel import make_mesh
 
     mesh = make_mesh()
     num_chips = mesh.size
     baselines = _load_baselines()
+    errors: dict = {}
 
-    with mesh:
-        # --- config 1: local MNIST softmax -------------------------------
+    def attempt(name, fn):
+        try:
+            fn()
+        except Exception as e:
+            errors[name] = repr(e)
+            traceback.print_exc()
+
+    def config1():
         step, ds, state, u = _make("softmax", "mnist", 100, 128, mesh,
                                    momentum=0.0, lr=0.5)
         best, rates, _ = _measure(step, ds, state, 1024, u)
@@ -193,10 +203,12 @@ def main() -> None:
               baselines, {"repeats": rates, "unroll": u,
                           "batch_per_chip": 100})
 
-        # --- config 4: CIFAR-10 ResNet-20, augmented ---------------------
+    def config4():
         step, ds, state, u = _make("resnet20", "cifar10", 256, 8, mesh,
                                    augment="cifar", lr=0.1)
-        flops = _flops_per_step(step, state, next(ds), u)
+        # peek, not next: the probe must not advance the ring ahead of
+        # state.step, or a later window would read an evicted perm row.
+        flops = _flops_per_step(step, state, ds.peek(), u)
         best, rates, _ = _measure(step, ds, state, 96, u)
         per_chip = best / num_chips
         # flops is whole-module (all devices); MFU = F*S_global/(N*peak)
@@ -207,7 +219,7 @@ def main() -> None:
                "flops_per_step": flops,
                "mfu": round(mfu, 4) if mfu is not None else None})
 
-        # --- config 2: MNIST CNN async (local-SGD emulation) -------------
+    def config2():
         step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
                                    sync=False)
         best, rates, _ = _measure(step, ds, state, 512, u)
@@ -215,7 +227,7 @@ def main() -> None:
               baselines, {"repeats": rates, "unroll": u,
                           "batch_per_chip": 256, "async_period": 8})
 
-        # --- hand-written kernel variants on the headline workload -------
+    def pallas_ce():
         step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
                                    ce_impl="pallas")
         best, rates, _ = _measure(step, ds, state, 512, u)
@@ -223,6 +235,7 @@ def main() -> None:
               best / num_chips, baselines,
               {"repeats": rates, "unroll": u, "batch_per_chip": 256})
 
+    def fused_sgd():
         step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
                                    fused_opt=True)
         best, rates, _ = _measure(step, ds, state, 512, u)
@@ -230,24 +243,42 @@ def main() -> None:
               best / num_chips, baselines,
               {"repeats": rates, "unroll": u, "batch_per_chip": 256})
 
+    with mesh:
+        attempt("softmax", config1)
+        attempt("resnet20", config4)
+        attempt("cnn_async", config2)
+        attempt("pallas_ce", pallas_ce)
+        attempt("fused_sgd", fused_sgd)
+
         # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
         sweep = {}
         best_overall, best_unroll, best_rates = 0.0, None, []
-        spe = 60000 // (256 * num_chips)   # full epoch = the unroll ceiling
-        for unroll in sorted({min(u, spe) for u in (16, 64, 128, spe)}):
-            step, ds, state, u = _make("mnist_cnn", "mnist", 256, unroll,
-                                       mesh)
-            best, rates, _ = _measure(step, ds, state,
-                                      max(512, u * 4), u)
-            sweep[str(u)] = rates
-            if best > best_overall:
-                best_overall, best_unroll, best_rates = best, u, rates
-        roofline = _roofline_probe(mesh, 256)
+        spe = 60000 // (256 * num_chips)
+        # Multi-epoch fused windows (the perm ring, data/device_dataset.py)
+        # let the unroll go past an epoch: sweep up to 8 epochs per call.
+        # Largest first: if the tunnel dies mid-sweep, the best candidate
+        # has already been measured.
+        for unroll in sorted({16, 128, spe, 4 * spe, 8 * spe}, reverse=True):
+            try:
+                step, ds, state, u = _make("mnist_cnn", "mnist", 256,
+                                           unroll, mesh)
+                best, rates, _ = _measure(step, ds, state,
+                                          max(512, u * 4), u)
+                sweep[str(u)] = rates
+                if best > best_overall:
+                    best_overall, best_unroll, best_rates = best, u, rates
+            except Exception as e:
+                errors[f"sweep_{unroll}"] = repr(e)
+                traceback.print_exc()
+        roofline = []
+        attempt("roofline", lambda: roofline.extend(
+            _roofline_probe(mesh, 256)))
         _emit("mnist_cnn_sync_steps_per_sec_per_chip",
               best_overall / num_chips, baselines,
               {"repeats": best_rates, "best_unroll": best_unroll,
                "unroll_sweep": sweep, "batch_per_chip": 256,
-               "roofline_probe": roofline})
+               "roofline_probe": roofline,
+               **({"errors": errors} if errors else {})})
 
 
 if __name__ == "__main__":

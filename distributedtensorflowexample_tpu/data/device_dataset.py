@@ -11,15 +11,19 @@ the split once and moves nothing per step: the epoch's shuffled index
 order is itself computed on device (``jax.random.permutation``), and the
 step slices its batch out of it by global-step position.
 
-Epoch double-buffering: the dataset always holds TWO epoch permutations in
-one device array of shape ``(2, epoch_len)`` — epoch ``e`` in slot
-``e % 2``, epoch ``e+1`` in the other slot.  The train step picks the slot
-from ``state.step // steps_per_epoch`` per fused sub-step, so one compiled
-multi-step call may cross an epoch boundary mid-scan.  That decouples the
+Epoch multi-buffering: the dataset holds a ring of S epoch permutations in
+one device array of shape ``(S, epoch_len)`` — epoch ``e`` in slot
+``e % S``.  The train step picks the slot from ``state.step //
+steps_per_epoch`` per fused sub-step, so one compiled multi-step call may
+cross up to ``S - 1`` epoch boundaries mid-scan.  That decouples the
 dispatch-amortizing unroll (``steps_per_next`` / ``unroll_steps``) from
-epoch arithmetic entirely: any unroll up to ``steps_per_epoch`` works, and
-the next epoch's permutation is computed (asynchronously, off the critical
-path) a whole epoch before it is first read.
+epoch arithmetic entirely: ``S`` is sized automatically from
+``steps_per_next`` (every epoch a window can touch, plus one prefetch
+slot), so multi-epoch fused windows work and the next epoch's permutation
+is computed (asynchronously, off the critical path) an epoch before it is
+first read.  Ring-slot overwrites are safe out of order: the jitted row
+update donates the buffer, and the device stream sequences it after every
+already-enqueued step that reads the old row.
 
 Shuffling semantics match the host ``Batcher``: epochs without
 replacement, the sub-batch remainder rows dropped per epoch.
@@ -43,21 +47,34 @@ import numpy as np
 class DeviceDataset:
     """Iterator yielding ``{"images", "labels", "perm"}`` device pytrees.
 
-    ``perm`` has shape ``(2, epoch_len)``: the current epoch's shuffled
-    index order in slot ``epoch % 2``, the next epoch's in the other slot.
-    The arrays are the same device buffers every step — only one perm row
-    is replaced, once per epoch.  Pass ``start_step`` (e.g. after a
-    resume) so epoch slots line up with the step's position arithmetic.
+    ``perm`` has shape ``(num_slots, epoch_len)``: epoch ``e``'s shuffled
+    index order lives in slot ``e % num_slots``.  The arrays are the same
+    device buffers every step — only one perm row is replaced, once per
+    epoch.  Pass ``start_step`` (e.g. after a resume) so epoch slots line
+    up with the step's position arithmetic.  Pass ``num_slots`` to the
+    step factory (``make_indexed_train_step(..., num_slots=ds.num_slots)``)
+    so its slot arithmetic matches.
     """
+
+    @staticmethod
+    def ring_slots_for(window_steps: int, steps_per_epoch: int) -> int:
+        """Perm-ring size for a ``window_steps``-step fused window: every
+        epoch one window can touch (a K-step window starting mid-epoch
+        spans ceil(K / spe) boundaries at worst -> that many + 1 epochs)
+        plus one slot so the next epoch prefetches without evicting a row
+        the in-flight window still reads.  THE single source of the slot
+        arithmetic — the step factories use it for their defaults, so
+        dataset and gather can't drift."""
+        return -(-window_steps // steps_per_epoch) + 2
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
                  shuffle: bool = True, start_step: int = 0,
                  steps_per_next: int = 1):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
-        the train step's ``unroll_steps`` so the perm pair is refreshed on
-        the right call.  Any value in ``[1, steps_per_epoch]`` works (a
-        fused window may cross one epoch boundary, never two)."""
+        the train step's ``unroll_steps`` so the perm ring is refreshed on
+        the right call.  Any value >= 1 works; the ring is sized to hold
+        every epoch one window can touch plus a prefetch slot."""
         if len(images) < batch_size:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than "
@@ -65,14 +82,14 @@ class DeviceDataset:
         self._n = len(images)
         self.steps_per_epoch = self._n // batch_size
         self.epoch_len = self.steps_per_epoch * batch_size
-        if not 1 <= steps_per_next <= self.steps_per_epoch:
+        if steps_per_next < 1:
             raise ValueError(
-                f"steps_per_next {steps_per_next} must be in [1, "
-                f"steps_per_epoch={self.steps_per_epoch}] (a fused window "
-                f"may cross at most one epoch boundary)")
+                f"steps_per_next {steps_per_next} must be >= 1")
+        self.num_slots = self.ring_slots_for(steps_per_next,
+                                             self.steps_per_epoch)
         self._spn = steps_per_next
         self._step = int(start_step)
-        self._slot_epochs: list[int | None] = [None, None]
+        self._slot_epochs: list[int | None] = [None] * self.num_slots
 
         if mesh is not None:
             from distributedtensorflowexample_tpu.parallel.mesh import (
@@ -105,26 +122,36 @@ class DeviceDataset:
         # Donated: the stale epoch's row is overwritten in place in HBM;
         # the runtime sequences the write after any in-flight reads.
         self._set_row = jax.jit(set_row, donate_argnums=0, **jit_kw)
-        self._pair = jax.jit(
-            lambda: jnp.zeros((2, self.epoch_len), jnp.int32), **jit_kw)()
+        self._ring = jax.jit(
+            lambda: jnp.zeros((self.num_slots, self.epoch_len), jnp.int32),
+            **jit_kw)()
 
     def _ensure_epoch(self, epoch: int) -> None:
-        slot = epoch % 2
+        slot = epoch % self.num_slots
         if self._slot_epochs[slot] != epoch:
             perm = self._make_perm(jnp.asarray(epoch, jnp.int32))
-            self._pair = self._set_row(self._pair, perm,
+            self._ring = self._set_row(self._ring, perm,
                                        jnp.asarray(slot, jnp.int32))
             self._slot_epochs[slot] = epoch
 
     def __iter__(self):
         return self
 
-    def __next__(self):
-        epoch = self._step // self.steps_per_epoch
-        # Both the window's possible epochs stay resident: e in slot e%2,
-        # e+1 in the other — computed one epoch ahead (double-buffered).
-        self._ensure_epoch(epoch)
-        self._ensure_epoch(epoch + 1)
-        self._step += self._spn
+    def peek(self):
+        """The next window's data WITHOUT consuming it — for compile/cost
+        probes that must not advance the ring past the training state."""
+        first = self._step // self.steps_per_epoch
+        last = (self._step + self._spn - 1) // self.steps_per_epoch
+        # Every epoch this window touches, plus one prefetched ahead (the
+        # prefetch may reuse the slot of an epoch an ALREADY-ENQUEUED call
+        # still reads — safe, the donated row update is stream-ordered
+        # after it).
+        for epoch in range(first, last + 2):
+            self._ensure_epoch(epoch)
         return {"images": self.images, "labels": self.labels,
-                "perm": self._pair}
+                "perm": self._ring}
+
+    def __next__(self):
+        data = self.peek()
+        self._step += self._spn
+        return data

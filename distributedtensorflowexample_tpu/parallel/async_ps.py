@@ -160,21 +160,21 @@ def make_indexed_async_train_step(num_workers: int, period: int,
                                   label_smoothing: float = 0.0,
                                   ce_impl: str = "xla", mesh=None,
                                   unroll_steps: int = 1,
-                                  augment: str = "none") -> Callable:
+                                  augment: str = "none",
+                                  num_slots: int | None = None) -> Callable:
     """Local-SGD step over a device-resident dataset — async's analog of
     ``sync.make_indexed_train_step``: same on-device gather from the
-    two-slot perm pair, same ``lax.scan`` multi-step fusion; the
-    period-aligned worker averaging runs inside the scan (``new_step %
-    period`` is exact whatever the unroll), so fused windows and averaging
-    periods compose freely."""
-    if not 1 <= unroll_steps <= steps_per_epoch:
-        raise ValueError(
-            f"unroll_steps {unroll_steps} must be in [1, steps_per_epoch="
-            f"{steps_per_epoch}] (a fused window may cross at most one "
-            f"epoch boundary)")
+    perm ring (multi-epoch fused windows supported), same ``lax.scan``
+    multi-step fusion; the period-aligned worker averaging runs inside
+    the scan (``new_step % period`` is exact whatever the unroll), so
+    fused windows and averaging periods compose freely."""
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        _resolve_num_slots)
+    num_slots = _resolve_num_slots(unroll_steps, steps_per_epoch, num_slots)
     inner = _build_async_step_fn(num_workers, period, label_smoothing,
                                  ce_impl, mesh)
-    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh)
+    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
+                                num_slots=num_slots)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))

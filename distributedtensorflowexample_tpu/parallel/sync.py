@@ -56,19 +56,39 @@ def make_loss_rows(label_smoothing: float = 0.0, ce_impl: str = "xla",
     return fused
 
 
+def _resolve_num_slots(unroll_steps: int, steps_per_epoch: int,
+                       num_slots: int | None) -> int:
+    """Default + validate a step factory's perm-ring size against the ONE
+    sizing rule (DeviceDataset.ring_slots_for)."""
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        DeviceDataset)
+    if unroll_steps < 1:
+        raise ValueError(f"unroll_steps {unroll_steps} must be >= 1")
+    needed = DeviceDataset.ring_slots_for(unroll_steps, steps_per_epoch)
+    if num_slots is None:
+        return needed
+    if num_slots < needed:
+        raise ValueError(
+            f"num_slots {num_slots} cannot hold a {unroll_steps}-step "
+            f"window over {steps_per_epoch}-step epochs (needs {needed})")
+    return num_slots
+
+
 def make_device_gather(batch_size: int, steps_per_epoch: int,
-                       augment: str = "none", mesh=None) -> Callable:
+                       augment: str = "none", mesh=None, *,
+                       num_slots: int) -> Callable:
     """(step, rng, data) -> batch: the on-device minibatch gather from a
     resident split (see ``data.DeviceDataset``), shared by the sync and
-    async indexed step builders."""
+    async indexed step builders.  ``num_slots`` must equal the dataset's
+    perm-ring size (``ds.num_slots``)."""
     if augment not in ("none", "cifar"):
         raise ValueError(f"unknown augment {augment!r}")
 
     def gather(step, rng, data):
         # In-epoch position from the global step; modulo first so the
-        # int32 product can't overflow on long runs.  The epoch's parity
-        # names its slot in the two-row perm pair (see DeviceDataset).
-        slot = (step // steps_per_epoch) % 2
+        # int32 product can't overflow on long runs.  The epoch names its
+        # slot in the perm ring (see DeviceDataset).
+        slot = (step // steps_per_epoch) % num_slots
         pos = (step % steps_per_epoch) * batch_size
         idx = jax.lax.dynamic_slice(data["perm"], (slot, pos),
                                     (1, batch_size))[0]
@@ -186,7 +206,8 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             ce_impl: str = "xla", mesh=None,
                             unroll_steps: int = 1,
                             augment: str = "none", num_replicas: int = 1,
-                            replicas_to_aggregate: int = 0) -> Callable:
+                            replicas_to_aggregate: int = 0,
+                            num_slots: int | None = None) -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -208,19 +229,18 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     device is reached through a high-latency link the dispatch round-trip
     dominates MNIST-sized steps, and this divides it by K — the TPU-native
     analog of Keras ``steps_per_execution``.  Each scanned sub-step picks
-    its epoch's perm slot (``(step // steps_per_epoch) & 1``) so a window
-    may cross one epoch boundary; any ``K <= steps_per_epoch`` works (pass
-    the same value as DeviceDataset's ``steps_per_next``); returned
-    metrics are the mean over the K updates.
+    its epoch's perm slot (``(step // steps_per_epoch) % num_slots``) so a
+    window may cross epoch boundaries — ANY ``K >= 1`` works, even
+    multi-epoch windows (the dataset sizes its perm ring to match; pass
+    the same ``unroll_steps`` as its ``steps_per_next`` and, if you
+    constructed the dataset yourself, ``num_slots=ds.num_slots``);
+    returned metrics are the mean over the K updates.
     """
-    if not 1 <= unroll_steps <= steps_per_epoch:
-        raise ValueError(
-            f"unroll_steps {unroll_steps} must be in [1, steps_per_epoch="
-            f"{steps_per_epoch}] (a fused window may cross at most one "
-            f"epoch boundary)")
+    num_slots = _resolve_num_slots(unroll_steps, steps_per_epoch, num_slots)
     inner = _build_step_fn(label_smoothing, ce_impl, mesh, num_replicas,
                            replicas_to_aggregate)
-    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh)
+    gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
+                                num_slots=num_slots)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))
@@ -297,7 +317,13 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
         from jax.sharding import NamedSharding, PartitionSpec as P
         shard = NamedSharding(mesh, P(None, DATA_AXIS))
         if jax.process_count() > 1:
-            put = lambda a: jax.make_array_from_process_local_data(shard, a)
+            # Every process holds the full split; its devices own a
+            # contiguous slice of the (sharded) batch axis — mesh device
+            # order groups devices by process (see put_global_batch).
+            pc, pi = jax.process_count(), jax.process_index()
+            per = batch_size // pc
+            put = lambda a: jax.make_array_from_process_local_data(
+                shard, np.ascontiguousarray(a[:, pi * per:(pi + 1) * per]))
         else:
             put = lambda a: jax.device_put(a, shard)
     else:

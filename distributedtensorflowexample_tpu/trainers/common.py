@@ -181,7 +181,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         train_step = make_indexed_async_train_step(
             num_replicas, cfg.async_period, global_batch, ds.steps_per_epoch,
             cfg.label_smoothing, ce_impl=ce_impl, mesh=mesh,
-            unroll_steps=steps_per_call, augment=device_augment)
+            unroll_steps=steps_per_call, augment=device_augment,
+            num_slots=ds.num_slots)
     elif is_async:
         train_step = make_async_train_step(num_replicas, cfg.async_period,
                                            cfg.label_smoothing,
@@ -191,7 +192,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
             ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call,
             augment=device_augment, num_replicas=num_replicas,
-            replicas_to_aggregate=cfg.replicas_to_aggregate)
+            replicas_to_aggregate=cfg.replicas_to_aggregate,
+            num_slots=ds.num_slots)
     else:
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
                                      mesh=mesh, num_replicas=num_replicas,

@@ -30,24 +30,27 @@ def test_epoch_is_permutation_without_replacement():
     mesh = make_mesh()
     ds = DeviceDataset(x, y, 64, mesh=mesh, seed=3)
     assert ds.steps_per_epoch == 520 // 64
-    pair = np.asarray(next(ds)["perm"])
-    assert pair.shape == (2, ds.epoch_len)
-    for row in pair:                                   # no replacement
-        assert len(np.unique(row)) == ds.epoch_len
-    assert not np.array_equal(pair[0], pair[1])        # distinct epochs
-    # The pair persists within the epoch; at the boundary the stale slot
-    # (epoch 0's row) is replaced by epoch 2's perm, epoch 1's row stays.
+    assert ds.num_slots == 3                           # spn=1: 1 epoch + 2
+    ring = np.asarray(next(ds)["perm"])
+    assert ring.shape == (3, ds.epoch_len)
+    for row in ring[:2]:                               # epochs 0,1 resident
+        assert len(np.unique(row)) == ds.epoch_len     # no replacement
+    assert not np.array_equal(ring[0], ring[1])        # distinct epochs
+    # The ring persists within the epoch; crossing into epoch 1 prefetches
+    # epoch 2 into slot 2, leaving epochs 0 and 1 untouched.
     for _ in range(ds.steps_per_epoch - 1):
-        np.testing.assert_array_equal(np.asarray(next(ds)["perm"]), pair)
-    pair2 = np.asarray(next(ds)["perm"])
-    np.testing.assert_array_equal(pair2[1], pair[1])
-    assert not np.array_equal(pair2[0], pair[0])
-    assert len(np.unique(pair2[0])) == ds.epoch_len
+        np.testing.assert_array_equal(np.asarray(next(ds)["perm"]), ring)
+    ring2 = np.asarray(next(ds)["perm"])
+    np.testing.assert_array_equal(ring2[0], ring[0])
+    np.testing.assert_array_equal(ring2[1], ring[1])
+    assert len(np.unique(ring2[2])) == ds.epoch_len    # epoch 2 prefetched
 
 
 def test_start_step_alignment_matches_fresh_run():
     """A dataset started at step k yields the same perm schedule a fresh
-    dataset reaches after k nexts — resume determinism."""
+    dataset reaches after k nexts — resume determinism.  Only the rows the
+    step can read (current epoch + prefetch) are compared: a resumed ring
+    doesn't backfill slots of epochs that already passed."""
     x, y = _data()
     mesh = make_mesh()
     k = 11
@@ -55,9 +58,14 @@ def test_start_step_alignment_matches_fresh_run():
     for _ in range(k):
         next(fresh)
     resumed = DeviceDataset(x, y, 64, mesh=mesh, seed=5, start_step=k)
-    for _ in range(5):
-        np.testing.assert_array_equal(np.asarray(next(fresh)["perm"]),
-                                      np.asarray(next(resumed)["perm"]))
+    assert fresh.num_slots == resumed.num_slots
+    spe, S = fresh.steps_per_epoch, fresh.num_slots
+    for i in range(5):
+        rf = np.asarray(next(fresh)["perm"])
+        rr = np.asarray(next(resumed)["perm"])
+        epoch = (k + i) // spe
+        for e in (epoch, epoch + 1):
+            np.testing.assert_array_equal(rf[e % S], rr[e % S])
 
 
 def test_indexed_step_consumes_each_epoch_row_once():
@@ -235,11 +243,38 @@ def test_no_truncation_and_unshuffled_order():
     np.testing.assert_array_equal(pair[0], np.arange(33 * 64))
 
 
-def test_steps_per_next_bounds():
+def test_steps_per_next_bounds_and_ring_sizing():
     x, y = _data(384)   # 6 steps/epoch at batch 64
     mesh = make_mesh()
-    DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=6)
-    with pytest.raises(ValueError, match="steps_per_next"):
-        DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=7)
+    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=6).num_slots == 3
+    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=7).num_slots == 4
+    assert DeviceDataset(x, y, 64, mesh=mesh,
+                         steps_per_next=24).num_slots == 6
     with pytest.raises(ValueError, match="steps_per_next"):
         DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=0)
+
+
+def test_multi_epoch_fused_window_matches_stepwise():
+    """A single fused window spanning MULTIPLE epochs (spe=6, K=15: three
+    boundary crossings in one compiled call) matches stepwise bitwise —
+    the perm ring holds every epoch the window touches."""
+    mesh = make_mesh()
+    x, y = _data(384)
+    b, K = 64, 15
+    ds1 = DeviceDataset(x, y, b, mesh=mesh, seed=11)
+    dsK = DeviceDataset(x, y, b, mesh=mesh, seed=11, steps_per_next=K)
+    assert dsK.num_slots == 5
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    s1, sK = make_state(), make_state()
+    one = make_indexed_train_step(b, 6)
+    fused = make_indexed_train_step(b, 6, unroll_steps=K)
+    with mesh:
+        for _ in range(2 * K):
+            s1, _ = one(s1, next(ds1))
+        for _ in range(2):
+            sK, _ = fused(sK, next(dsK))
+    assert int(s1.step) == int(sK.step) == 2 * K       # 5 epochs covered
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s1.params, sK.params)

@@ -62,3 +62,76 @@ def test_two_process_tf_config_training(tmp_path):
     # Chief-only logging: step lines from process 0 only.
     assert "step 2:" in outputs[0]
     assert "step 2:" not in outputs[1]
+    # Sanity: the collective program returns one global accuracy, so both
+    # processes must report the identical summary value.  (Slice
+    # correctness of the resident eval is pinned by the dedicated test
+    # below, which compares against the host-fed evaluate.)
+    accs = [out.split("acc=")[1].split()[0] for out in outputs]
+    assert accs[0] == accs[1], f"process accuracies diverged: {accs}"
+    assert 0.0 <= float(accs[0]) <= 1.0
+
+
+_EVAL_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributedtensorflowexample_tpu import cluster
+from distributedtensorflowexample_tpu.config import RunConfig
+info = cluster.resolve(RunConfig())            # TF_CONFIG from the env
+cluster.maybe_initialize_distributed(info)
+import optax
+from distributedtensorflowexample_tpu.data import mnist
+mnist._SYNTH_SIZES = {"train": 512, "test": 256}
+from distributedtensorflowexample_tpu.data.mnist import load_mnist
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.parallel import (
+    batch_sharding, make_mesh, replicated_sharding)
+from distributedtensorflowexample_tpu.parallel.sync import (
+    evaluate, make_resident_eval)
+from distributedtensorflowexample_tpu.training.state import TrainState
+mesh = make_mesh()
+assert mesh.size == 2 and jax.process_count() == 2
+x, y = load_mnist("/nonexistent", "test")
+state = TrainState.create_sharded(build_model("softmax"), optax.sgd(0.1),
+                                  (64, 28, 28, 1), 3,
+                                  replicated_sharding(mesh))
+with mesh:
+    host = evaluate(state, x, y, batch_size=64,
+                    sharding=batch_sharding(mesh))
+    res = make_resident_eval(x, y, batch_size=64, mesh=mesh)(state)
+print("EVALS host=%.6f resident=%.6f" % (host, res))
+assert abs(host - res) < 1e-9, (host, res)
+print("EVAL_OK")
+"""
+
+
+def test_two_process_resident_eval_matches_host_eval(tmp_path):
+    """The device-resident eval's per-process COLUMN slices of the test
+    split must reproduce the host-fed evaluate() exactly over 2 real
+    processes — a wrong local slice shows up as a different accuracy."""
+    port = _free_port()
+    workers = [f"127.0.0.1:{port}", f"127.0.0.1:{_free_port()}"]
+    procs = []
+    for idx in range(2):
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["TF_CONFIG"] = (
+            '{"cluster": {"worker": ["%s", "%s"]}, '
+            '"task": {"type": "worker", "index": %d}}'
+            % (workers[0], workers[1], idx))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _EVAL_SCRIPT],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for idx, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {idx} failed:\n{out}"
+        assert "EVAL_OK" in out, out

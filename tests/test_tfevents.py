@@ -113,6 +113,26 @@ def test_negative_step_encodes_without_hang(tmp_path):
     assert fields[2] == 0xFFFFFFFFFFFFFFFF  # -1 as unsigned two's complement
 
 
+def test_real_tensorboard_reads_our_file(tmp_path):
+    """Cross-validate against TensorBoard's own event-file loader (present
+    in this image): the hand-rolled framing/proto must parse as genuine
+    tf.summary scalars, not just round-trip through our reader."""
+    pytest.importorskip("tensorboard")
+    from tensorboard.backend.event_processing import event_file_loader
+
+    w = tfevents.TFEventsWriter(str(tmp_path))
+    w.scalar(7, "loss", 1.25, wall_time=42.0)
+    w.scalar(8, "accuracy", 0.5, wall_time=43.0)
+    w.close()
+
+    events = list(event_file_loader.LegacyEventFileLoader(w.path).Load())
+    assert events[0].file_version == "brain.Event:2"
+    scalars = [(e.step, v.tag, v.simple_value)
+               for e in events[1:] for v in e.summary.value]
+    assert scalars == [(7, "loss", 1.25), (8, "accuracy", 0.5)]
+    assert events[1].wall_time == 42.0
+
+
 def test_metrics_logger_writes_tfevents(tmp_path):
     from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
 
