@@ -3,25 +3,13 @@ version of the reference's 'N processes on localhost' launch).
 
 Spawns 2 python processes with a reference-style TF_CONFIG; each resolves
 the cluster, calls jax.distributed.initialize (Gloo CPU collectives), forms
-one 2-device mesh, and trains config 5 for a few steps.
+one 2-device mesh, and runs the workload under test.
 """
 
 import os
 import socket
 import subprocess
 import sys
-
-_WORKER_SCRIPT = """
-import jax
-jax.config.update("jax_platforms", "cpu")
-from distributedtensorflowexample_tpu.trainers import trainer_multiworker_cifar
-s = trainer_multiworker_cifar.main([
-    "--train_steps", "4", "--batch_size", "4", "--log_dir", {logdir!r},
-    "--data_dir", "/nonexistent", "--resume", "false", "--log_every", "2",
-])
-print("SUMMARY steps=%d replicas=%d acc=%.4f"
-      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
-"""
 
 
 def _free_port() -> int:
@@ -30,9 +18,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_tf_config_training(tmp_path):
-    port = _free_port()
-    workers = [f"127.0.0.1:{port}", f"127.0.0.1:{_free_port()}"]
+def _run_two_workers(script_template: str, tmp_path) -> list[str]:
+    """Launch 2 OS worker processes with a reference-style TF_CONFIG, wait
+    for both, assert both exited 0, and return their outputs."""
+    workers = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
     procs = []
     for idx in range(2):
         env = dict(os.environ)
@@ -41,7 +30,7 @@ def test_two_process_tf_config_training(tmp_path):
             '{"cluster": {"worker": ["%s", "%s"]}, '
             '"task": {"type": "worker", "index": %d}}'
             % (workers[0], workers[1], idx))
-        script = _WORKER_SCRIPT.format(logdir=str(tmp_path / f"w{idx}"))
+        script = script_template.format(logdir=str(tmp_path / f"w{idx}"))
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script],
             env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
@@ -58,6 +47,25 @@ def test_two_process_tf_config_training(tmp_path):
                 p.wait()
     for idx, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {idx} failed:\n{out}"
+    return outputs
+
+
+_WORKER_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributedtensorflowexample_tpu.trainers import trainer_multiworker_cifar
+s = trainer_multiworker_cifar.main([
+    "--train_steps", "4", "--batch_size", "4", "--log_dir", {logdir!r},
+    "--data_dir", "/nonexistent", "--resume", "false", "--log_every", "2",
+])
+print("SUMMARY steps=%d replicas=%d acc=%.4f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+"""
+
+
+def test_two_process_tf_config_training(tmp_path):
+    outputs = _run_two_workers(_WORKER_SCRIPT, tmp_path)
+    for out in outputs:
         assert "SUMMARY steps=4 replicas=2" in out, out
     # Chief-only logging: step lines from process 0 only.
     assert "step 2:" in outputs[0]
@@ -71,6 +79,35 @@ def test_two_process_tf_config_training(tmp_path):
     assert 0.0 <= float(accs[0]) <= 1.0
 
 
+_ASYNC_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributedtensorflowexample_tpu.data import mnist
+mnist._SYNTH_SIZES = {{"train": 256, "test": 128}}
+from distributedtensorflowexample_tpu.trainers import trainer_ps_mnist
+s = trainer_ps_mnist.main([
+    "--train_steps", "8", "--batch_size", "8", "--global_batch", "true",
+    "--steps_per_loop", "2", "--async_period", "4",
+    "--log_dir", {logdir!r}, "--data_dir", "/nonexistent",
+    "--resume", "false", "--log_every", "4", "--learning_rate", "0.05",
+])
+print("SUMMARY steps=%d replicas=%d acc=%.4f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+"""
+
+
+def test_two_process_async_local_sgd(tmp_path):
+    """Config 2 (async local-SGD, device-resident, fused steps) over 2 real
+    OS processes: worker-tiled state spans the 2-device mesh, the periodic
+    averaging all-reduce crosses the process boundary, and the consolidated
+    eval agrees."""
+    outputs = _run_two_workers(_ASYNC_SCRIPT, tmp_path)
+    for out in outputs:
+        assert "SUMMARY steps=8 replicas=2" in out, out
+    accs = [out.split("acc=")[1].split()[0] for out in outputs]
+    assert accs[0] == accs[1], f"process accuracies diverged: {accs}"
+
+
 _EVAL_SCRIPT = """
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -80,7 +117,7 @@ info = cluster.resolve(RunConfig())            # TF_CONFIG from the env
 cluster.maybe_initialize_distributed(info)
 import optax
 from distributedtensorflowexample_tpu.data import mnist
-mnist._SYNTH_SIZES = {"train": 512, "test": 256}
+mnist._SYNTH_SIZES = {{"train": 512, "test": 256}}
 from distributedtensorflowexample_tpu.data.mnist import load_mnist
 from distributedtensorflowexample_tpu.models import build_model
 from distributedtensorflowexample_tpu.parallel import (
@@ -100,7 +137,7 @@ with mesh:
     res = make_resident_eval(x, y, batch_size=64, mesh=mesh)(state)
 print("EVALS host=%.6f resident=%.6f" % (host, res))
 assert abs(host - res) < 1e-9, (host, res)
-print("EVAL_OK")
+print("EVAL_OK {logdir}")
 """
 
 
@@ -108,30 +145,6 @@ def test_two_process_resident_eval_matches_host_eval(tmp_path):
     """The device-resident eval's per-process COLUMN slices of the test
     split must reproduce the host-fed evaluate() exactly over 2 real
     processes — a wrong local slice shows up as a different accuracy."""
-    port = _free_port()
-    workers = [f"127.0.0.1:{port}", f"127.0.0.1:{_free_port()}"]
-    procs = []
-    for idx in range(2):
-        env = dict(os.environ)
-        env["PALLAS_AXON_POOL_IPS"] = ""
-        env["TF_CONFIG"] = (
-            '{"cluster": {"worker": ["%s", "%s"]}, '
-            '"task": {"type": "worker", "index": %d}}'
-            % (workers[0], workers[1], idx))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _EVAL_SCRIPT],
-            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=280)
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-    for idx, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"worker {idx} failed:\n{out}"
+    outputs = _run_two_workers(_EVAL_SCRIPT, tmp_path)
+    for out in outputs:
         assert "EVAL_OK" in out, out
