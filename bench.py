@@ -78,7 +78,8 @@ def _measure(step, ds, state, steps: int, unroll: int,
 def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
           mesh, *, momentum: float = 0.9, ce_impl: str = "xla",
           fused_opt: bool = False, augment: str = "none", lr: float = 0.05,
-          sync: bool = True, async_period: int = 8):
+          sync: bool = True, async_period: int = 8,
+          data_dir: str = "/tmp/data"):
     import optax
 
     from distributedtensorflowexample_tpu.data import DeviceDataset
@@ -96,7 +97,7 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
     global_batch = batch_per_chip * num_chips
     load = load_mnist if dataset == "mnist" else load_cifar10
     sample = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
-    train_x, train_y = load("/tmp/data", "train")
+    train_x, train_y = load(data_dir, "train")
     ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh, seed=0,
                        steps_per_next=unroll)
 
