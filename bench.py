@@ -196,13 +196,16 @@ def main() -> None:
             errors[name] = repr(e)
             traceback.print_exc()
 
-    def config1():
-        step, ds, state, u = _make("softmax", "mnist", 100, 128, mesh,
-                                   momentum=0.0, lr=0.5)
-        best, rates, _ = _measure(step, ds, state, 1024, u)
-        _emit("mnist_softmax_steps_per_sec_per_chip", best / num_chips,
-              baselines, {"repeats": rates, "unroll": u,
-                          "batch_per_chip": 100})
+    def run_simple(metric, model, dataset, batch_per_chip, unroll, steps,
+                   extra_detail=None, **make_kw):
+        """Build + measure one workload and emit its line (the shape every
+        non-headline config shares)."""
+        step, ds, state, u = _make(model, dataset, batch_per_chip, unroll,
+                                   mesh, **make_kw)
+        best, rates, _ = _measure(step, ds, state, steps, u)
+        _emit(metric, best / num_chips, baselines,
+              {"repeats": rates, "unroll": u,
+               "batch_per_chip": batch_per_chip, **(extra_detail or {})})
 
     def config4():
         step, ds, state, u = _make("resnet20", "cifar10", 256, 8, mesh,
@@ -220,36 +223,20 @@ def main() -> None:
                "flops_per_step": flops,
                "mfu": round(mfu, 4) if mfu is not None else None})
 
-    def config2():
-        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
-                                   sync=False)
-        best, rates, _ = _measure(step, ds, state, 512, u)
-        _emit("mnist_cnn_async_steps_per_sec_per_chip", best / num_chips,
-              baselines, {"repeats": rates, "unroll": u,
-                          "batch_per_chip": 256, "async_period": 8})
-
-    def pallas_ce():
-        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
-                                   ce_impl="pallas")
-        best, rates, _ = _measure(step, ds, state, 512, u)
-        _emit("mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip",
-              best / num_chips, baselines,
-              {"repeats": rates, "unroll": u, "batch_per_chip": 256})
-
-    def fused_sgd():
-        step, ds, state, u = _make("mnist_cnn", "mnist", 256, 64, mesh,
-                                   fused_opt=True)
-        best, rates, _ = _measure(step, ds, state, 512, u)
-        _emit("mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip",
-              best / num_chips, baselines,
-              {"repeats": rates, "unroll": u, "batch_per_chip": 256})
-
     with mesh:
-        attempt("softmax", config1)
+        attempt("softmax", lambda: run_simple(
+            "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
+            100, 128, 1024, momentum=0.0, lr=0.5))
         attempt("resnet20", config4)
-        attempt("cnn_async", config2)
-        attempt("pallas_ce", pallas_ce)
-        attempt("fused_sgd", fused_sgd)
+        attempt("cnn_async", lambda: run_simple(
+            "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
+            256, 64, 512, extra_detail={"async_period": 8}, sync=False))
+        attempt("pallas_ce", lambda: run_simple(
+            "mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip", "mnist_cnn",
+            "mnist", 256, 64, 512, ce_impl="pallas"))
+        attempt("fused_sgd", lambda: run_simple(
+            "mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip", "mnist_cnn",
+            "mnist", 256, 64, 512, fused_opt=True))
 
         # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
         sweep = {}

@@ -31,7 +31,6 @@ import argparse
 import json
 import math
 import re
-import time
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
                 "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
@@ -131,32 +130,28 @@ def main() -> None:
         step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
                                        mesh=mesh, unroll_steps=args.unroll)
         with mesh:
-            data = next(ds)
             # Per-step collective traffic from a SINGLE-step compile: in
             # the unrolled program the collectives live inside the scan
             # body (once in the module text, executed every sub-step), so
             # the one-step module is the honest per-step accounting.
+            # peek, not next: lowering must not advance the perm ring
+            # ahead of state.step.
             one_step = make_indexed_train_step(
                 global_batch, ds.steps_per_epoch, mesh=mesh, unroll_steps=1)
             per_step = collective_traffic(
-                one_step.lower(state, data).compile().as_text())
-            state, metrics = step(state, data)   # warmup
-            jax.block_until_ready(metrics)
-            rates = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(args.steps // args.unroll):
-                    state, metrics = step(state, next(ds))
-                jax.block_until_ready(metrics)
-                rates.append(args.steps / (time.perf_counter() - t0))
-        results[n] = {"steps_per_sec": max(rates),
-                      "repeats": [round(r, 1) for r in rates],
+                one_step.lower(state, ds.peek()).compile().as_text())
+            # Same warmup/best-of-repeats measurement the main bench uses.
+            from bench import _measure
+            best, rates, _ = _measure(step, ds, state, args.steps,
+                                      args.unroll, warmup_calls=1)
+        results[n] = {"steps_per_sec": best,
+                      "repeats": rates,
                       "collectives_per_step": per_step}
         print(json.dumps({
             "devices": n, "backend": backend,
             "global_batch": global_batch,
-            "steps_per_sec": round(max(rates), 2),
-            "repeats": [round(r, 1) for r in rates],
+            "steps_per_sec": round(best, 2),
+            "repeats": rates,
             "collectives_per_step": per_step,
         }), flush=True)
 
