@@ -223,25 +223,31 @@ def main() -> None:
                "flops_per_step": flops,
                "mfu": round(mfu, 4) if mfu is not None else None})
 
+    # Multi-epoch fused windows everywhere (the perm ring removed the
+    # per-epoch unroll ceiling): softmax steps are ~10x shorter than CNN
+    # steps so they need the deepest fusion; the kernel variants use the
+    # same unroll as the headline sweep's 4-epoch point so their deltas
+    # read directly against sweep["936"] (single-chip).
+    spe = 60000 // (256 * num_chips)
     with mesh:
         attempt("softmax", lambda: run_simple(
             "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
-            100, 128, 1024, momentum=0.0, lr=0.5))
+            100, 2048, 4096, momentum=0.0, lr=0.5))
         attempt("resnet20", config4)
         attempt("cnn_async", lambda: run_simple(
             "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
-            256, 64, 512, extra_detail={"async_period": 8}, sync=False))
+            256, 4 * spe, 8 * spe, extra_detail={"async_period": 8},
+            sync=False))
         attempt("pallas_ce", lambda: run_simple(
             "mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 64, 512, ce_impl="pallas"))
+            "mnist", 256, 4 * spe, 8 * spe, ce_impl="pallas"))
         attempt("fused_sgd", lambda: run_simple(
             "mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 64, 512, fused_opt=True))
+            "mnist", 256, 4 * spe, 8 * spe, fused_opt=True))
 
         # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
         sweep = {}
         best_overall, best_unroll, best_rates = 0.0, None, []
-        spe = 60000 // (256 * num_chips)
         # Multi-epoch fused windows (the perm ring, data/device_dataset.py)
         # let the unroll go past an epoch: sweep up to 16 epochs per call
         # (even 43 ms/call of degraded-tunnel dispatch amortizes to <3%).

@@ -2,9 +2,11 @@
 
 Reference stack (SURVEY.md §3c): ``tf.train.SyncReplicasOptimizer`` with
 PS-side gradient accumulators + token-queue barrier over 2 workers.
-Rebuild: the barrier IS the XLA psum inside one jitted step over the mesh —
-``replicas_to_aggregate`` == mesh size always (exact sync, no stragglers to
-tolerate because the step is a single SPMD program).
+Rebuild: the barrier IS the XLA psum inside one jitted step over the mesh.
+By default every replica's gradient enters every update (exact sync — the
+SPMD program has no stragglers to tolerate); ``--replicas_to_aggregate R``
+restores SyncReplicasOptimizer's partial aggregation as a deterministic
+rotating subset of R replica gradients per step (parallel/sync.py).
 """
 
 from __future__ import annotations
