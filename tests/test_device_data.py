@@ -232,6 +232,40 @@ def test_unrolled_step_across_epoch_boundary_matches_stepwise():
                  s1.params, sK.params)
 
 
+def test_resume_mid_epoch_with_multi_epoch_windows():
+    """Resume at a mid-epoch step with a window longer than an epoch
+    (spe=6, K=15): the resumed dataset + step must continue the fresh
+    run's trajectory bitwise."""
+    mesh = make_mesh()
+    x, y = _data(384)
+    b, K = 64, 15
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    step = make_indexed_train_step(b, 6, unroll_steps=K)
+
+    ds_full = DeviceDataset(x, y, b, mesh=mesh, seed=13, steps_per_next=K)
+    assert ds_full.steps_per_epoch == 6   # the literal the step was built on
+    s_full = make_state()
+    with mesh:
+        for _ in range(3):
+            s_full, _ = step(s_full, next(ds_full))
+
+    # "Resume": replay the first window, then continue with a dataset
+    # constructed at start_step=K (mid-epoch: 15 % 6 = 3).
+    ds_head = DeviceDataset(x, y, b, mesh=mesh, seed=13, steps_per_next=K)
+    s_res = make_state()
+    with mesh:
+        s_res, _ = step(s_res, next(ds_head))
+        ds_resumed = DeviceDataset(x, y, b, mesh=mesh, seed=13,
+                                   start_step=K, steps_per_next=K)
+        for _ in range(2):
+            s_res, _ = step(s_res, next(ds_resumed))
+    assert int(s_full.step) == int(s_res.step) == 3 * K
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s_full.params, s_res.params)
+
+
 def test_no_truncation_and_unshuffled_order():
     """Epochs keep every whole batch (only the sub-batch remainder drops,
     matching the host Batcher) and shuffle=False yields identity order."""
