@@ -75,7 +75,11 @@ class RunConfig:
 
     # --- hand-written TPU kernels (ops/pallas) ---
     pallas_ce: bool = False         # fused Pallas loss head in the train step
-    fused_optimizer: bool = False   # fused Pallas momentum-SGD apply
+    fused_optimizer: bool = False   # fused Pallas momentum-SGD apply; measured
+                                    # 2.3x SLOWER than XLA's fused apply on a
+                                    # v5e chip (flatten/unflatten HBM traffic,
+                                    # see BASELINE.md round-2) — kept opt-in
+                                    # as the kernel-authoring reference
 
     # --- input pipeline ---
     device_data: str = "auto"       # auto | on | off — dataset resident in

@@ -78,6 +78,30 @@ def test_roofline_probe(tiny_mnist):
     assert len(rates) == bench.REPEATS and all(r > 0 for r in rates)
 
 
+def test_sweep_fault_isolation(tiny_mnist):
+    """_sweep records a failing point into errors and keeps going; the
+    all-fail case returns best_unroll=None (config4 then emits nothing)."""
+    mesh = make_mesh()
+
+    def mk(unroll):
+        if unroll == 2:
+            raise RuntimeError("boom")
+        return bench._make("softmax", "mnist", 8, unroll, mesh,
+                           momentum=0.0, lr=0.5, data_dir=tiny_mnist)
+
+    errors = {}
+    with mesh:
+        best, best_u, rates, sweep = bench._sweep(
+            {2, 4}, mk, lambda u: u, "p_", errors)
+    assert best > 0 and best_u == 4 and list(sweep) == ["4"]
+    assert "p_2" in errors and "boom" in errors["p_2"]
+
+    errors = {}
+    best, best_u, rates, sweep = bench._sweep(
+        {2}, mk, lambda u: u, "p_", errors)
+    assert best == 0.0 and best_u is None and sweep == {} and "p_2" in errors
+
+
 def test_emit_shape(capsys):
     bench._emit("some_metric", 123.456, {"some_metric": 100.0},
                 {"repeats": [1.0]})
