@@ -22,7 +22,14 @@ reduce-scatter / collective-permute / all-to-all) — on a 1-D data mesh the
 expected shape is ONE fused gradient all-reduce of ~|params| f32 bytes.
 
 Emits one JSON line per device count and a final summary line
-``{"metric": "sync_sgd_weak_scaling", ...}``.
+``{"metric": "<mode>_sgd_weak_scaling", ...}``.
+
+``--mode async`` runs the config-2 local-SGD step instead: each device
+steps its own virtual worker and the worker average all-reduces only every
+``--async_period`` steps, so the sustained collective bytes per step are
+the sync mode's divided by the period (reported as
+``amortized_bytes_per_step``) — the communication-scaling advantage the
+async path buys at the price of bounded staleness.
 """
 
 from __future__ import annotations
@@ -78,7 +85,16 @@ def main() -> None:
     parser.add_argument("--unroll", type=int, default=16)
     parser.add_argument("--steps", type=int, default=64,
                         help="measured steps per repeat (3 repeats)")
+    parser.add_argument("--mode", choices=("sync", "async"), default="sync",
+                        help="sync = one gradient all-reduce per step; "
+                             "async = local-SGD (config 2), whose worker "
+                             "average all-reduces only every "
+                             "--async_period steps — the per-step "
+                             "collective bytes divide by the period")
+    parser.add_argument("--async_period", type=int, default=8)
     args = parser.parse_args()
+    if args.mode == "async" and args.async_period < 1:
+        parser.error(f"--async_period must be >= 1, got {args.async_period}")
 
     import jax
     if not args.real:
@@ -107,6 +123,8 @@ def main() -> None:
     from distributedtensorflowexample_tpu.models import build_model
     from distributedtensorflowexample_tpu.parallel import (
         make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.async_ps import (
+        make_indexed_async_train_step, make_worker_state)
     from distributedtensorflowexample_tpu.parallel.sync import (
         make_indexed_train_step)
     from distributedtensorflowexample_tpu.training.state import TrainState
@@ -127,8 +145,19 @@ def main() -> None:
         state = TrainState.create_sharded(
             model, optax.sgd(0.05, momentum=0.9),
             (global_batch, 28, 28, 1), 0, replicated_sharding(mesh))
-        step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
-                                       mesh=mesh, unroll_steps=args.unroll)
+        if args.mode == "async":
+            state = make_worker_state(state, n, mesh)
+
+            def make_step(unroll):
+                return make_indexed_async_train_step(
+                    n, args.async_period, global_batch, ds.steps_per_epoch,
+                    mesh=mesh, unroll_steps=unroll)
+        else:
+            def make_step(unroll):
+                return make_indexed_train_step(
+                    global_batch, ds.steps_per_epoch, mesh=mesh,
+                    unroll_steps=unroll)
+        step = make_step(args.unroll)
         with mesh:
             # Per-step collective traffic from a SINGLE-step compile: in
             # the unrolled program the collectives live inside the scan
@@ -136,10 +165,8 @@ def main() -> None:
             # the one-step module is the honest per-step accounting.
             # peek, not next: lowering must not advance the perm ring
             # ahead of state.step.
-            one_step = make_indexed_train_step(
-                global_batch, ds.steps_per_epoch, mesh=mesh, unroll_steps=1)
             per_step = collective_traffic(
-                one_step.lower(state, ds.peek()).compile().as_text())
+                make_step(1).lower(state, ds.peek()).compile().as_text())
             # Same warmup/best-of-repeats measurement the main bench uses.
             from bench import _measure
             best, rates, _ = _measure(step, ds, state, args.steps,
@@ -147,23 +174,34 @@ def main() -> None:
         results[n] = {"steps_per_sec": best,
                       "repeats": rates,
                       "collectives_per_step": per_step}
-        print(json.dumps({
-            "devices": n, "backend": backend,
+        line = {
+            "devices": n, "backend": backend, "mode": args.mode,
             "global_batch": global_batch,
             "steps_per_sec": round(best, 2),
             "repeats": rates,
             "collectives_per_step": per_step,
-        }), flush=True)
+        }
+        if args.mode == "async":
+            # The worker-average all-reduce sits in a lax.cond branch: it
+            # appears once in the module text but executes only every
+            # --async_period-th step, so the sustained wire cost is the
+            # parsed bytes divided by the period — local SGD's whole
+            # communication advantage over per-step sync.
+            line["amortized_bytes_per_step"] = {
+                op: round(v["bytes"] / args.async_period)
+                for op, v in per_step.items()}
+        print(json.dumps(line), flush=True)
 
     base = results[counts[0]]["steps_per_sec"]
     efficiency = {str(n): round(results[n]["steps_per_sec"] / base, 4)
                   for n in counts}
     print(json.dumps({
-        "metric": "sync_sgd_weak_scaling",
+        "metric": f"{args.mode}_sgd_weak_scaling",
         "value": efficiency[str(counts[-1])],
         "unit": f"efficiency_1_to_{counts[-1]}",
         "vs_baseline": 1.0,
-        "detail": {"backend": backend, "efficiency": efficiency,
+        "detail": {"backend": backend, "mode": args.mode,
+                   "efficiency": efficiency,
                    "batch_per_chip": args.batch_per_chip,
                    "note": ("real-chip contract numbers require multi-chip "
                             "hardware (--real); virtual CPU meshes share "

@@ -113,6 +113,28 @@ def test_emit_shape(capsys):
     assert line["detail"]["repeats"] == [1.0]
 
 
+def test_scaling_async_mode(monkeypatch, capsys):
+    """bench_scaling --mode async end-to-end on tiny sizes: emits per-count
+    lines with period-amortized collective bytes and the summary line."""
+    monkeypatch.setattr("sys.argv", [
+        "bench_scaling.py", "--mode", "async", "--async_period", "2",
+        "--max_devices", "2", "--batch_per_chip", "4", "--unroll", "2",
+        "--steps", "4"])
+    bench_scaling.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    summary = lines[-1]
+    assert summary["metric"] == "async_sgd_weak_scaling"
+    assert summary["detail"]["mode"] == "async"
+    per_count = [l for l in lines[:-1] if l.get("mode") == "async"]
+    assert [l["devices"] for l in per_count] == [1, 2]
+    two = per_count[-1]
+    # The 2-device worker average is an all-reduce in the program; its
+    # sustained cost is parsed bytes / period.
+    assert "all-reduce" in two["collectives_per_step"]
+    assert two["amortized_bytes_per_step"]["all-reduce"] == round(
+        two["collectives_per_step"]["all-reduce"]["bytes"] / 2)
+
+
 def test_collective_traffic_parsing():
     hlo = """
   %x = f32[256,10]{1,0} all-reduce(f32[256,10]{1,0} %a), replica_groups={}
