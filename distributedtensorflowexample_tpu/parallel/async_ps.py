@@ -8,11 +8,15 @@ XLA-native analog (one program, lockstep devices), so we emulate the
 statistical behavior TPU-natively:
 
 * each of the mesh's devices hosts one *virtual worker* — a full parameter
-  copy, sharded along ``DATA_AXIS`` on a leading worker axis (a vmap over
-  the mesh: every device steps ITS worker's params on ITS batch shard,
-  zero cross-device traffic);
-* every ``period`` steps the copies are averaged (the mean over the worker
-  axis lowers to an all-reduce over ICI) — bounded staleness instead of
+  copy, sharded along ``DATA_AXIS`` on a leading worker axis; on a
+  multi-device mesh the per-worker compute runs under ``jax.shard_map``
+  over that axis (``_build_shard_map_step``), so every device steps ITS
+  workers' params on ITS batch shard with zero cross-device traffic
+  between averaging points by construction (letting GSPMD partition a
+  plain ``vmap`` instead was measured to all-gather the worker-tiled conv
+  weights — see the shard_map builder's docstring);
+* every ``period`` steps the copies are averaged (an explicit ``psum``
+  over the worker axis, riding ICI) — bounded staleness instead of
   unbounded PS races, same "workers diverge then reconcile" dynamics,
   fully deterministic and restartable.
 
@@ -70,6 +74,50 @@ def consolidate(state: TrainState) -> TrainState:
                          if state.batch_stats else state.batch_stats)
 
 
+def _worker_updates(state: TrainState, loss_rows: Callable, n_workers: int,
+                    params, opt_state, stats, images, labels, rngs):
+    """One local-SGD update for ``n_workers`` worker copies stacked on the
+    leading axis — the per-worker body shared by the vmap (full worker
+    axis) and shard_map (device-local slice) paths.
+
+    Per-worker gradients come from ONE ``value_and_grad`` of the summed
+    per-worker mean losses: worker ``w``'s parameters only reach
+    ``loss_w``, so d(sum)/d(params_w) IS that worker's gradient — same
+    math as a per-worker grad transform, but the loss head runs on the
+    worker-major flattened [n*Bw, C] logits OUTSIDE the vmap, where the
+    Pallas CE kernel can apply (a ``pallas_call`` has no batching rule).
+
+    Returns (new_params, new_opt, new_stats, loss_w, logits) — params
+    un-averaged; the caller applies its period-aligned worker average.
+    """
+    has_bn = bool(stats)
+
+    def fwd(p, st, img, rng):
+        variables = {"params": p}
+        if has_bn:
+            variables["batch_stats"] = st
+            logits, updated = state.apply_fn(
+                variables, img, train=True,
+                rngs={"dropout": rng}, mutable=["batch_stats"])
+            return logits, updated["batch_stats"]
+        logits = state.apply_fn(variables, img, train=True,
+                                rngs={"dropout": rng})
+        return logits, st
+
+    def loss_all(params):
+        logits, new_stats = jax.vmap(fwd)(params, stats, images, rngs)
+        rows = loss_rows(logits.reshape(-1, logits.shape[-1]),
+                         labels.reshape(-1))
+        loss_w = rows.reshape(n_workers, -1).mean(axis=1)
+        return jnp.sum(loss_w), (loss_w, logits, new_stats)
+
+    (_, (loss_w, logits, new_stats)), grads = jax.value_and_grad(
+        loss_all, has_aux=True)(params)
+    updates, new_opt = jax.vmap(state.tx.update)(grads, opt_state, params)
+    new_params = jax.vmap(optax.apply_updates)(params, updates)
+    return new_params, new_opt, new_stats, loss_w, logits
+
+
 def _build_async_step_fn(num_workers: int, period: int,
                          label_smoothing: float = 0.0, ce_impl: str = "xla",
                          mesh=None) -> Callable:
@@ -78,19 +126,19 @@ def _build_async_step_fn(num_workers: int, period: int,
 
     The batch arrives as the usual global batch sharded on DATA_AXIS; it
     is reshaped to [workers, per_worker_batch, ...] (device-local, no data
-    movement).  Per-worker gradients come from ONE ``value_and_grad`` of
-    the summed per-worker mean losses: worker ``w``'s parameters only
-    reach ``loss_w``, so d(sum)/d(params_w) IS that worker's gradient —
-    same math as a per-worker grad under vmap, but the loss head runs on
-    the worker-major flattened [W*Bw, C] logits OUTSIDE the vmap, which
-    lets the Pallas CE kernel apply under its usual shard_map-over-batch
-    pattern (a ``pallas_call`` has no batching rule XLA can partition).
+    movement) and stepped by the shared ``_worker_updates`` body.
+
+    On a multi-device mesh the whole per-worker computation runs under
+    ``jax.shard_map`` over the worker axis (``_build_shard_map_step``);
+    with no mesh (or one device) this plain ``vmap`` body is used.
     """
     period = max(1, int(period))
+    if mesh is not None and mesh.size > 1:
+        return _build_shard_map_step(num_workers, period, label_smoothing,
+                                     ce_impl, mesh)
     loss_rows = make_loss_rows(label_smoothing, ce_impl, mesh)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
-        has_bn = bool(state.batch_stats)
         W = num_workers
 
         # [G, ...] -> [W, G/W, ...]; shards are device-local so this is free.
@@ -100,32 +148,9 @@ def _build_async_step_fn(num_workers: int, period: int,
         worker_rngs = jax.random.split(step_rng, W)
         flat_labels = wbatch["label"].reshape(-1)
 
-        def loss_all(stacked_params):
-            def fwd(params, stats, image, rng):
-                variables = {"params": params}
-                if has_bn:
-                    variables["batch_stats"] = stats
-                    logits, updated = state.apply_fn(
-                        variables, image, train=True,
-                        rngs={"dropout": rng}, mutable=["batch_stats"])
-                    return logits, updated["batch_stats"]
-                logits = state.apply_fn(variables, image, train=True,
-                                        rngs={"dropout": rng})
-                return logits, stats
-
-            logits, new_stats = jax.vmap(fwd)(
-                stacked_params, state.batch_stats, wbatch["image"],
-                worker_rngs)
-            rows = loss_rows(logits.reshape(-1, logits.shape[-1]),
-                             flat_labels)
-            loss_w = rows.reshape(W, -1).mean(axis=1)
-            return jnp.sum(loss_w), (loss_w, logits, new_stats)
-
-        (_, (loss_w, logits, new_stats)), grads = jax.value_and_grad(
-            loss_all, has_aux=True)(state.params)
-        updates, new_opt = jax.vmap(state.tx.update)(
-            grads, state.opt_state, state.params)
-        new_params = jax.vmap(optax.apply_updates)(state.params, updates)
+        new_params, new_opt, new_stats, loss_w, logits = _worker_updates(
+            state, loss_rows, W, state.params, state.opt_state,
+            state.batch_stats, wbatch["image"], wbatch["label"], worker_rngs)
 
         new_step = state.step + 1
 
@@ -143,6 +168,89 @@ def _build_async_step_fn(num_workers: int, period: int,
                    "accuracy": accuracy(
                        logits.reshape(-1, logits.shape[-1]), flat_labels)}
         return new_state, metrics
+
+    return step
+
+
+def _build_shard_map_step(num_workers: int, period: int,
+                          label_smoothing: float, ce_impl: str,
+                          mesh) -> Callable:
+    """Multi-device local-SGD step: the per-worker compute runs under
+    ``jax.shard_map`` over the worker axis, so every device steps ONLY its
+    own workers' parameter copies — zero collectives between averaging
+    points, by construction.
+
+    Why not let GSPMD partition the ``vmap`` body?  Measured on the
+    8-device mesh (bench_scaling --mode async, round 2): the vmapped conv
+    lowers to one grouped convolution whose worker axis is folded into the
+    channel dim, and the SPMD partitioner then ALL-GATHERS the worker-tiled
+    conv weights and activations (4 all-gathers sized like the gathered
+    operands per step) and re-computes every worker's conv on every device
+    — redundant compute and wire traffic that explicit per-device
+    ``shard_map`` eliminates.  The cond-gated worker average becomes an
+    explicit ``psum`` over the worker axis; everything else is local.
+
+    Math is identical to the vmap body: same per-worker rngs, same
+    separable summed-loss gradients, same period-aligned average (floats
+    reduce in a different order, so results agree to fp tolerance, not
+    bitwise, with the vmap path).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    D = mesh.size
+    if num_workers % D:
+        raise ValueError(
+            f"num_workers {num_workers} must be a multiple of the mesh "
+            f"size {D} (one or more whole virtual workers per device)")
+    local_W = num_workers // D
+    W = num_workers
+    loss_rows = make_loss_rows(label_smoothing, ce_impl, mesh=None)
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        wbatch = jax.tree.map(
+            lambda x: x.reshape((W, x.shape[0] // W) + x.shape[1:]), batch)
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        worker_rngs = jax.random.split(step_rng, W)
+
+        def shard_body(step_no, params, opt_state, stats, images, labels,
+                       rngs):
+            # Everything here is the device's local [local_W, ...] slice.
+            new_params, new_opt, new_stats, loss_w, logits = _worker_updates(
+                state, loss_rows, local_W, params, opt_state, stats, images,
+                labels, rngs)
+
+            def average(tree):
+                def avg(x):
+                    s = jnp.sum(x.astype(jnp.float32), axis=0, keepdims=True)
+                    s = jax.lax.psum(s, DATA_AXIS) / W
+                    return jnp.broadcast_to(s.astype(x.dtype), x.shape)
+                return jax.tree.map(avg, tree)
+
+            new_params = jax.lax.cond((step_no + 1) % period == 0,
+                                      average, lambda t: t, new_params)
+            flat_logits = logits.reshape(-1, logits.shape[-1])
+            flat_labels = labels.reshape(-1)
+            total = flat_labels.shape[0] * D      # static global batch
+            local_correct = jnp.sum(
+                (jnp.argmax(flat_logits, axis=-1) == flat_labels)
+                .astype(jnp.float32))
+            # One fused all-reduce for both scalar metrics.
+            loss_sum, correct = jax.lax.psum(
+                (jnp.sum(loss_w), local_correct), DATA_AXIS)
+            return (new_params, new_opt, new_stats, loss_sum / W,
+                    correct / total)
+
+        wspec = P(DATA_AXIS)
+        body = jax.shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), wspec, wspec, wspec, wspec, wspec, wspec),
+            out_specs=(wspec, wspec, wspec, P(), P()), check_vma=False)
+        new_params, new_opt, new_stats, loss, acc = body(
+            state.step, state.params, state.opt_state, state.batch_stats,
+            wbatch["image"], wbatch["label"], worker_rngs)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt, batch_stats=new_stats)
+        return new_state, {"loss": loss, "accuracy": acc}
 
     return step
 
