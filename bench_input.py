@@ -1,0 +1,138 @@
+"""Host input-pipeline micro-benchmark — the native C++ loader vs numpy.
+
+The in-tree native runtime (``native/dataio.cc`` via ctypes) backs the
+host-fed input path (``--device_data off``): IDX/CIFAR byte parsing and
+the per-step batch gather + crop/flip augmentation.  This harness measures
+both implementations on identical inputs so the native component's worth
+is a recorded number, not an assertion.  Pure host CPU — no TPU needed.
+
+Emits one JSON line per stage:
+``{"metric": ..., "value": <native rate>, "unit": ...,
+   "vs_baseline": <native/numpy speedup>, "detail": {...}}``.
+
+Both paths are bit-identical by construction (the random draws happen
+once, outside the timed region — ``data/cifar10.py::_draw``); this harness
+asserts that on every run before timing.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+
+import numpy as np
+
+REPEATS = 3
+
+
+def _time(fn, iters: int) -> float:
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def _emit(metric: str, value: float, unit: str, speedup: float,
+          detail: dict) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 1),
+                      "unit": unit, "vs_baseline": round(speedup, 3),
+                      "detail": detail}), flush=True)
+
+
+def bench_cifar_parse(n_records: int = 10000) -> None:
+    from distributedtensorflowexample_tpu import native
+
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, size=n_records * 3073,
+                      dtype=np.uint8).tobytes()
+    rows = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3073)
+
+    def numpy_parse():
+        nhwc = rows[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return nhwc.astype(np.float32) / 255.0, rows[:, 0].astype(np.int32)
+
+    ni, nl = native.parse_cifar(raw)
+    pi, pl = numpy_parse()
+    np.testing.assert_array_equal(ni, pi)
+    np.testing.assert_array_equal(nl, pl)
+
+    mb = len(raw) / 1e6
+    t_native = _time(lambda: native.parse_cifar(raw), 3)
+    t_numpy = _time(numpy_parse, 3)
+    _emit("cifar_parse_native_mb_per_sec", mb / t_native, "MB/sec",
+          t_numpy / t_native,
+          {"records": n_records, "numpy_mb_per_sec": round(mb / t_numpy, 1),
+           "omp_threads": native.omp_threads()})
+
+
+def bench_idx_parse(n: int = 60000) -> None:
+    from distributedtensorflowexample_tpu import native
+
+    rng = np.random.RandomState(1)
+    body = rng.randint(0, 256, size=n * 28 * 28, dtype=np.uint8)
+    raw = struct.pack(">IIII", 2051, n, 28, 28) + body.tobytes()
+
+    def numpy_parse():
+        data = np.frombuffer(raw, dtype=np.uint8, count=n * 28 * 28,
+                             offset=16)
+        return data.reshape(n, 28, 28, 1).astype(np.float32) / 255.0
+
+    np.testing.assert_array_equal(native.parse_idx_images(raw),
+                                  numpy_parse())
+    mb = len(raw) / 1e6
+    t_native = _time(lambda: native.parse_idx_images(raw), 3)
+    t_numpy = _time(numpy_parse, 3)
+    _emit("idx_parse_native_mb_per_sec", mb / t_native, "MB/sec",
+          t_numpy / t_native,
+          {"images": n, "numpy_mb_per_sec": round(mb / t_numpy, 1)})
+
+
+def bench_gather_augment(n_src: int = 50000, batch: int = 256) -> None:
+    """The per-step host work of an augmented CIFAR run (--device_data
+    off): gather batch rows + reflect-pad-4 crop + hflip.  Native does it
+    in one fused OpenMP pass; numpy gathers then augments."""
+    from distributedtensorflowexample_tpu import native
+    from distributedtensorflowexample_tpu.data.cifar10 import (
+        _augment_numpy, _draw)
+
+    rng = np.random.RandomState(2)
+    src = rng.rand(n_src, 32, 32, 3).astype(np.float32)
+    idx = rng.randint(0, n_src, size=batch).astype(np.int64)
+    ys, xs, flips = _draw(np.random.RandomState(3), batch)
+
+    def native_fused():
+        return native.gather_augment(src, idx, ys, xs, flips)
+
+    def numpy_path():
+        return _augment_numpy(src[idx], ys, xs, flips)
+
+    np.testing.assert_array_equal(native_fused(), numpy_path())
+    t_native = _time(native_fused, 20)
+    t_numpy = _time(numpy_path, 20)
+    _emit("gather_augment_native_images_per_sec", batch / t_native,
+          "images/sec", t_numpy / t_native,
+          {"batch": batch, "source_rows": n_src,
+           "numpy_images_per_sec": round(batch / t_numpy, 1)})
+
+
+def main() -> None:
+    from distributedtensorflowexample_tpu import native
+
+    if not native.available():
+        print(json.dumps({"metric": "native_loader", "value": 0,
+                          "unit": "unavailable", "vs_baseline": 0.0,
+                          "detail": {"note": "toolchain/build unavailable; "
+                                             "numpy fallback is the only "
+                                             "path"}}), flush=True)
+        return
+    bench_cifar_parse()
+    bench_idx_parse()
+    bench_gather_augment()
+
+
+if __name__ == "__main__":
+    main()

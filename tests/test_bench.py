@@ -135,6 +135,24 @@ def test_scaling_async_mode(monkeypatch, capsys):
         two["collectives_per_step"]["all-reduce"]["bytes"] / 2)
 
 
+def test_bench_input_stages(capsys):
+    """bench_input's three stages run end-to-end on tiny sizes (each
+    asserts native/numpy bit-identity itself before timing)."""
+    import bench_input
+    from distributedtensorflowexample_tpu import native
+
+    if not native.available():
+        pytest.skip("native loader unavailable on this host")
+    bench_input.bench_cifar_parse(n_records=50)
+    bench_input.bench_idx_parse(n=200)
+    bench_input.bench_gather_augment(n_src=300, batch=16)
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [l["metric"] for l in lines] == [
+        "cifar_parse_native_mb_per_sec", "idx_parse_native_mb_per_sec",
+        "gather_augment_native_images_per_sec"]
+    assert all(l["value"] > 0 and l["vs_baseline"] > 0 for l in lines)
+
+
 def test_collective_traffic_parsing():
     hlo = """
   %x = f32[256,10]{1,0} all-reduce(f32[256,10]{1,0} %a), replica_groups={}
