@@ -46,5 +46,7 @@ def small_synthetic(monkeypatch):
     on the 1-core CI host stretch XLA:CPU's 8-thread collective rendezvous
     past its hard timeout (flaky aborts).  Semantics under test don't
     depend on split size."""
-    from distributedtensorflowexample_tpu.data import mnist
+    from distributedtensorflowexample_tpu.data import cifar10, mnist
     monkeypatch.setattr(mnist, "_SYNTH_SIZES", {"train": 2048, "test": 512})
+    monkeypatch.setattr(cifar10, "_SYNTH_SIZES",
+                        {"train": 2048, "test": 512})

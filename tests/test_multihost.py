@@ -53,6 +53,8 @@ def _run_two_workers(script_template: str, tmp_path) -> list[str]:
 _WORKER_SCRIPT = """
 import jax
 jax.config.update("jax_platforms", "cpu")
+from distributedtensorflowexample_tpu.data import cifar10
+cifar10._SYNTH_SIZES = {{"train": 512, "test": 256}}
 from distributedtensorflowexample_tpu.trainers import trainer_multiworker_cifar
 s = trainer_multiworker_cifar.main([
     "--train_steps", "4", "--batch_size", "4", "--log_dir", {logdir!r},

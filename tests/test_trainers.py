@@ -38,7 +38,7 @@ def test_ps_role_exits_with_notice(tmp_log_dir, capsys):
     assert "exit" in capsys.readouterr().out.lower()
 
 
-def test_mirrored_resnet_smoke(tmp_log_dir):
+def test_mirrored_resnet_smoke(tmp_log_dir, small_synthetic):
     summary = trainer_mirrored_cifar.main(_common_flags(
         tmp_log_dir, ["--train_steps", "10", "--batch_size", "8",
                       "--warmup_steps", "2"]))
@@ -46,7 +46,7 @@ def test_mirrored_resnet_smoke(tmp_log_dir):
     assert np.isfinite(summary["final_accuracy"])
 
 
-def test_multiworker_trainer_single_process(tmp_log_dir):
+def test_multiworker_trainer_single_process(tmp_log_dir, small_synthetic):
     """Config 5 entrypoint degenerates correctly to one process (the same
     SPMD program; the mesh simply spans one host's devices)."""
     from distributedtensorflowexample_tpu.trainers import (
