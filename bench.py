@@ -268,10 +268,14 @@ def main() -> None:
     # same unroll as the headline sweep's 4-epoch point so their deltas
     # read directly against sweep["936"] (single-chip).
     spe = 60000 // (256 * num_chips)
+    # Softmax steps are ~10x shorter than CNN steps, so dispatch still
+    # shows at unroll 2048 (~3.4 epochs); fuse 16 epochs per call like the
+    # headline sweep's deepest point.
+    spe_softmax = 60000 // (100 * num_chips)
     with mesh:
         attempt("softmax", lambda: run_simple(
             "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
-            100, 2048, 4096, momentum=0.0, lr=0.5))
+            100, 16 * spe_softmax, 32 * spe_softmax, momentum=0.0, lr=0.5))
         attempt("resnet20", config4)
         attempt("cnn_async", lambda: run_simple(
             "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
