@@ -1,0 +1,91 @@
+"""LR-schedule and optimizer construction (training/optimizers.py).
+
+The step schedule's drops are advertised at 50%/75% of --train_steps in
+GLOBAL steps; with warmup the piecewise schedule is evaluated at
+(step - warmup_steps), so the boundary arithmetic re-frames them — these
+tests pin that the drops land where the docstring says.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflowexample_tpu.config import RunConfig
+from distributedtensorflowexample_tpu.training.optimizers import (
+    build_optimizer, build_schedule)
+
+
+def _lr(sched, step: int) -> float:
+    return float(sched(np.int32(step)))
+
+
+def test_constant_schedule():
+    sched = build_schedule(RunConfig(learning_rate=0.3,
+                                     lr_schedule="constant",
+                                     train_steps=100))
+    assert _lr(sched, 0) == _lr(sched, 99) == pytest.approx(0.3)
+
+
+def test_cosine_decays_to_zero():
+    sched = build_schedule(RunConfig(learning_rate=0.2, lr_schedule="cosine",
+                                     train_steps=100))
+    assert _lr(sched, 0) == pytest.approx(0.2)
+    assert _lr(sched, 50) == pytest.approx(0.1, rel=1e-3)
+    assert _lr(sched, 100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_step_schedule_drops_at_advertised_global_steps():
+    sched = build_schedule(RunConfig(learning_rate=0.1, lr_schedule="step",
+                                     train_steps=100))
+    assert _lr(sched, 49) == pytest.approx(0.1)
+    assert _lr(sched, 50) == pytest.approx(0.01)
+    assert _lr(sched, 74) == pytest.approx(0.01)
+    assert _lr(sched, 75) == pytest.approx(0.001)
+
+
+def test_step_schedule_with_warmup_keeps_global_drop_points():
+    """Warmup shifts the schedule's evaluation frame; the /10 drops must
+    still land at 50% and 75% of train_steps in GLOBAL steps."""
+    sched = build_schedule(RunConfig(learning_rate=0.1, lr_schedule="step",
+                                     train_steps=100, warmup_steps=10))
+    assert _lr(sched, 0) == pytest.approx(0.0)          # warmup start
+    assert _lr(sched, 5) == pytest.approx(0.05)         # linear ramp
+    assert _lr(sched, 10) == pytest.approx(0.1)         # ramp done
+    assert _lr(sched, 49) == pytest.approx(0.1)
+    assert _lr(sched, 50) == pytest.approx(0.01)        # global 50%
+    assert _lr(sched, 75) == pytest.approx(0.001)       # global 75%
+
+
+def test_unknown_schedule_rejected():
+    with pytest.raises(ValueError, match="unknown lr_schedule"):
+        build_schedule(RunConfig(lr_schedule="nope", train_steps=10))
+
+
+def test_weight_decay_chains_decay_before_sgd():
+    """weight_decay > 0 adds decoupled decay: the update for zero
+    gradients is -lr * wd * param."""
+    import jax.numpy as jnp
+
+    tx = build_optimizer(RunConfig(learning_rate=0.1, momentum=0.0,
+                                   weight_decay=0.01, train_steps=10))
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.zeros((4,))}, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               -0.1 * 0.01 * np.ones(4), rtol=1e-5)
+
+
+def test_momentum_sgd_matches_optax_reference():
+    import jax.numpy as jnp
+
+    tx = build_optimizer(RunConfig(learning_rate=0.1, momentum=0.9,
+                                   train_steps=10))
+    ref = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.full((3,), 0.5)}
+    s1, s2 = tx.init(params), ref.init(params)
+    for _ in range(3):
+        u1, s1 = tx.update(grads, s1, params)
+        u2, s2 = ref.update(grads, s2, params)
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]),
+                               rtol=1e-6)

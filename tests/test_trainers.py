@@ -30,6 +30,22 @@ def test_sync_cnn_smoke(tmp_log_dir):
     assert np.isfinite(summary["final_accuracy"])
 
 
+def test_eval_every_writes_scalars(tmp_log_dir, small_synthetic):
+    """--eval_every wires the EvalHook: periodic eval_accuracy scalars in
+    scalars.jsonl at the boundary-crossing steps."""
+    import json
+    import os
+
+    trainer_local_mnist.main(_common_flags(
+        tmp_log_dir, ["--train_steps", "40", "--batch_size", "32",
+                      "--eval_every", "20"]))
+    with open(os.path.join(tmp_log_dir, "scalars.jsonl")) as f:
+        scalars = [json.loads(l) for l in f]
+    evals = [s for s in scalars if "eval_accuracy" in s]
+    assert [s["step"] for s in evals] == [20, 40]
+    assert all(0.0 <= s["eval_accuracy"] <= 1.0 for s in evals)
+
+
 def test_ps_role_exits_with_notice(tmp_log_dir, capsys):
     summary = trainer_ps_mnist.main(
         ["--job_name", "ps", "--task_index", "0",
