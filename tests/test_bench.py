@@ -153,6 +153,24 @@ def test_bench_input_stages(capsys):
     assert all(l["value"] > 0 and l["vs_baseline"] > 0 for l in lines)
 
 
+def test_main_emits_headline_when_backend_unreachable(monkeypatch, capsys):
+    """A mid-outage driver run must still print one valid headline line
+    pointing at the recorded manual run."""
+    from distributedtensorflowexample_tpu import parallel
+
+    def boom(*a, **k):
+        raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+
+    monkeypatch.setattr(parallel, "make_mesh", boom)
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    assert lines[0]["value"] == 0.0
+    assert "UNAVAILABLE" in lines[0]["detail"]["error"]
+    assert "BENCH_manual_r02" in lines[0]["detail"]["see"]
+
+
 def test_collective_traffic_parsing():
     hlo = """
   %x = f32[256,10]{1,0} all-reduce(f32[256,10]{1,0} %a), replica_groups={}
