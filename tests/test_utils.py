@@ -10,6 +10,42 @@ from distributedtensorflowexample_tpu.utils import (
     ProfilerHook, RateMeter, Timer, chief_print, timed_block, trace_context)
 
 
+class _FakeTime:
+    """Settable clock standing in for the metrics module's ``time``."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def perf_counter(self):
+        return self.now
+
+
+def test_metrics_logger_excludes_hook_time(monkeypatch):
+    """steps_per_sec is a TRAINING rate: hook wall time reported via
+    exclude() must not depress the next window, and over-discounting must
+    skip the rate rather than emit a bogus one (deterministic fake clock)."""
+    from distributedtensorflowexample_tpu.training import metrics as m
+
+    clock = _FakeTime()
+    monkeypatch.setattr(m, "time", clock)
+    logger = m.MetricsLogger(log_every=100)
+    logger.start(0)
+
+    clock.now = 10.0                       # 100 steps in 10s of training
+    logger.maybe_log(100, {"loss": jnp.asarray(1.0)})
+    assert logger.last_steps_per_sec == 10.0
+
+    logger.exclude(5.0)                    # a 5s eval/checkpoint hook
+    clock.now = 25.0                       # +10s training, +5s hook
+    logger.maybe_log(200, {"loss": jnp.asarray(1.0)})
+    assert logger.last_steps_per_sec == 10.0   # hook time discounted
+
+    logger.exclude(100.0)                  # hook outlived the window
+    clock.now = 30.0
+    logger.maybe_log(300, {"loss": jnp.asarray(1.0)})
+    assert logger.last_steps_per_sec == 10.0   # bogus rate skipped
+
+
 def test_trace_context_writes_xplane(tmp_path):
     logdir = str(tmp_path / "trace")
     with trace_context(logdir):
