@@ -41,22 +41,40 @@ class TrainLoop:
             h.begin(self)
         self._logger.start(start)
         metrics = None
-        for step in range(start + self._spc, self._num_steps + 1, self._spc):
-            state, metrics = self._train_step(state, next(self._batches))
-            self._logger.maybe_log(step, metrics)
-            # Every hook sees every step (no short-circuit) — a stop request
-            # must not mask another hook's work at the same step.  Hook wall
-            # time (eval, checkpoint serialization) is discounted from the
-            # throughput window so steps_per_sec stays a training rate.
-            t_hooks = time.perf_counter()
-            stops = [h.after_step(step, state, metrics) for h in self._hooks]
-            self._logger.exclude(time.perf_counter() - t_hooks)
-            if any(stops):
-                break
+        interrupted = None
+        try:
+            for step in range(start + self._spc, self._num_steps + 1,
+                              self._spc):
+                state, metrics = self._train_step(state, next(self._batches))
+                self._logger.maybe_log(step, metrics)
+                # Every hook sees every step (no short-circuit) — a stop
+                # request must not mask another hook's work at the same
+                # step.  Hook wall time (eval, checkpoint serialization) is
+                # discounted from the throughput window so steps_per_sec
+                # stays a training rate.
+                t_hooks = time.perf_counter()
+                stops = [h.after_step(step, state, metrics)
+                         for h in self._hooks]
+                self._logger.exclude(time.perf_counter() - t_hooks)
+                if any(stops):
+                    break
+        except KeyboardInterrupt as e:
+            # MonitoredTrainingSession saved on exit; preserve the same
+            # Ctrl-C behavior — `state` is the last COMPLETED step's state,
+            # safe to hand to the end-hooks (final checkpoint) below.  Say
+            # so: the save can take seconds, and a silent pause invites a
+            # second Ctrl-C that would abort it.
+            from distributedtensorflowexample_tpu.utils.logging import (
+                chief_print)
+            chief_print(f"interrupted at step {int(state.step)} — running "
+                        f"exit hooks (final checkpoint) before exiting")
+            interrupted = e
         # Drain outstanding device work so end-hooks (checkpoint) see final
         # values and wall-clock accounting is honest.
         if metrics is not None:
             jax.block_until_ready(metrics)
         for h in self._hooks:
             h.end(state)
+        if interrupted is not None:
+            raise interrupted
         return state

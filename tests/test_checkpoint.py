@@ -208,6 +208,27 @@ def test_async_worker_tiled_resume_matches_uninterrupted(tmp_path):
     mgr.close()
 
 
+def test_interrupt_still_checkpoints_final_state(tmp_path):
+    """Ctrl-C mid-run: end-hooks save the last completed step before the
+    KeyboardInterrupt propagates (MonitoredTrainingSession's exit-save)."""
+
+    from distributedtensorflowexample_tpu.training.hooks import Hook
+
+    class InterruptAt(Hook):
+        def after_step(self, step, state, metrics):
+            if step == 3:
+                raise KeyboardInterrupt
+            return False
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    loop = TrainLoop(make_train_step(), iter(_batches(6)), 6,
+                     hooks=[InterruptAt(), CheckpointHook(mgr, every=0)])
+    with pytest.raises(KeyboardInterrupt):
+        loop.run(_fresh_state())
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
 def test_run_metadata_roundtrip(tmp_path):
     d = str(tmp_path / "ckpt")
     mgr = CheckpointManager(d, run_metadata={"sync_mode": "sync"})
