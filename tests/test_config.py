@@ -84,3 +84,21 @@ def test_tf_config_chief_job(monkeypatch):
     assert info.process_id == 2
     assert info.coordinator_address == "c:1"
     assert not info.is_chief
+
+
+def test_every_trainer_help_exits_clean(capsys):
+    """--help works on all five entrypoints (catches flag-definition and
+    import-time breakage in one sweep)."""
+    import importlib
+
+    import pytest
+
+    for name in ("trainer_local_mnist", "trainer_ps_mnist",
+                 "trainer_sync_mnist", "trainer_mirrored_cifar",
+                 "trainer_multiworker_cifar"):
+        mod = importlib.import_module(
+            f"distributedtensorflowexample_tpu.trainers.{name}")
+        with pytest.raises(SystemExit) as exc:
+            mod.main(["--help"])
+        assert exc.value.code == 0
+        assert "--train_steps" in capsys.readouterr().out
