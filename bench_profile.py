@@ -1,0 +1,157 @@
+"""On-chip ResNet-20 profiling + MFU attribution (VERDICT r2 item 2).
+
+Decomposes the flagship workload's throughput in ONE process/window (the
+shared chip's ~10-20x cross-window variance makes cross-window deltas
+meaningless, BASELINE_SELF.json note):
+
+  measured(augment)    the contract config-4 path (crop/flip on device)
+  measured(no augment) same fused gather/perm-ring path, augment off
+  roofline             scanned fixed resident batch — no gather/augment/
+                       per-call dispatch (bench._roofline_probe)
+
+  augment share   = 1 - rate_aug / rate_noaug
+  input+dispatch  = 1 - rate_noaug / rate_roofline
+  compute quality = rate_roofline vs the analytic MXU ceiling (printed as
+                    mfu_roofline; the residual is conv MXU underfill at
+                    widths 16/32/64 + BN/elementwise HBM traffic —
+                    attributed by the trace)
+
+Also captures a jax.profiler trace of a steady-state window (NOT the
+compile) when the backend supports it; emits one JSON line per variant,
+same shape as bench.py lines.
+
+Usage (on the chip):  python bench_profile.py --unroll 195
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+import traceback
+
+import jax
+
+import bench
+
+
+def _emit(metric: str, value: float, detail: dict) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": "steps/sec/chip", "vs_baseline": 1.0,
+                      "detail": detail}), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--unroll", type=int, default=195,
+                    help="fused steps per call (195 = 1 epoch at batch 256)")
+    ap.add_argument("--steps", type=int, default=390)
+    ap.add_argument("--batch_per_chip", type=int, default=256)
+    ap.add_argument("--trace_dir", default="/tmp/resnet_trace")
+    ap.add_argument("--skip_trace", action="store_true")
+    args = ap.parse_args()
+
+    from distributedtensorflowexample_tpu.parallel import make_mesh
+    mesh = make_mesh()
+    n = mesh.size
+    rates = {}
+    errors = {}
+
+    def attempt(name, fn):
+        """Per-stage fault isolation, like bench.main: a tunnel drop in
+        one variant must not eat the lines the earlier variants already
+        paid for (nor the attribution summary below)."""
+        try:
+            fn()
+        except Exception as e:
+            errors[name] = repr(e)
+            traceback.print_exc()
+
+    def run_variant(tag, aug):
+        step, ds, state, u = bench._make(
+            "resnet20", "cifar10", args.batch_per_chip, args.unroll,
+            mesh, augment=aug, lr=0.1)
+        flops = bench._flops_per_step(step, state, ds.peek(), u)
+        best, reps, state = bench._measure(step, ds, state, args.steps, u)
+        rates[tag] = best
+        mfu = (flops * best / n / bench.PEAK_FLOPS) if flops else None
+        _emit(f"resnet20_profile_{tag}", best / n,
+              {"repeats": reps, "unroll": u, "flops_per_step": flops,
+               "mfu": round(mfu, 5) if mfu else None})
+        return step, ds, state, u
+
+    with mesh:
+        for tag, aug in (("augment", "cifar"), ("no_augment", "none")):
+            box = []
+            attempt(tag, lambda: box.append(run_variant(tag, aug)))
+            if not box:
+                continue
+            step, ds, state, u = box[0]
+
+            if tag == "augment" and not args.skip_trace:
+                # Trace ONE steady-state call (state is warm, program
+                # cached) — the trace shows the op-level time breakdown
+                # the MFU number alone can't give.
+                try:
+                    jax.profiler.start_trace(args.trace_dir)
+                    try:
+                        t0 = time.perf_counter()
+                        state, m = step(state, next(ds))
+                        jax.block_until_ready(m)
+                        dt = time.perf_counter() - t0
+                    finally:
+                        # Never leave the profiler running: it would skew
+                        # the no_augment + roofline rates measured next.
+                        jax.profiler.stop_trace()
+                    files = glob.glob(os.path.join(
+                        args.trace_dir, "**", "*"), recursive=True)
+                    nbytes = sum(os.path.getsize(f) for f in files
+                                 if os.path.isfile(f))
+                    _emit("resnet20_traced_window", u / dt / n,
+                          {"trace_dir": args.trace_dir,
+                           "trace_files": len(files),
+                           "trace_bytes": nbytes,
+                           "steps_in_window": u})
+                except Exception as e:
+                    traceback.print_exc()
+                    print(json.dumps({
+                        "metric": "resnet20_traced_window",
+                        "value": 0.0, "unit": "unavailable",
+                        "vs_baseline": 0.0,
+                        "detail": {"error": f"profiler failed: {e!r}"[:400]},
+                    }), flush=True)
+
+        def run_roofline():
+            roof = bench._roofline_probe(mesh, args.batch_per_chip,
+                                         length=128, model_name="resnet20",
+                                         sample=(32, 32, 3), lr=0.1)
+            rates["roofline"] = max(roof)
+            _emit("resnet20_roofline", max(roof) / n, {"repeats": roof})
+
+        attempt("roofline", run_roofline)
+
+    # Attribution from whatever survived — partial shares still tell the
+    # story of the window (errors ride along for the missing pieces).
+    detail = {}
+    if "augment" in rates and "no_augment" in rates:
+        detail["augment_share"] = round(
+            1 - rates["augment"] / rates["no_augment"], 4)
+    if "no_augment" in rates and "roofline" in rates:
+        detail["input_dispatch_share"] = round(
+            1 - rates["no_augment"] / rates["roofline"], 4)
+    if errors:
+        detail["errors"] = errors
+    if detail or ("augment" in rates and "roofline" in rates):
+        print(json.dumps({
+            "metric": "resnet20_attribution",
+            "value": (round(rates["augment"] / rates["roofline"], 4)
+                      if "augment" in rates and "roofline" in rates
+                      else 0.0),
+            "unit": "measured/roofline", "vs_baseline": 1.0,
+            "detail": detail}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -190,6 +190,13 @@ def main() -> None:
             line["amortized_bytes_per_step"] = {
                 op: round(v["bytes"] / args.async_period)
                 for op, v in per_step.items()}
+            # The parsed all-reduce bucket also holds the scalar
+            # loss/accuracy metrics psum, which runs EVERY step (not
+            # cond-gated), so the division is exact only for the worker
+            # average; the error is the ~8-byte metrics psum per step.
+            line["amortized_note"] = (
+                "exact for the cond-gated worker average only; the "
+                "every-step scalar-metrics psum bytes are amortized too")
         print(json.dumps(line), flush=True)
 
     base = results[counts[0]]["steps_per_sec"]

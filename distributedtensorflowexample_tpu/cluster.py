@@ -90,8 +90,12 @@ def resolve(cfg: RunConfig) -> ClusterInfo:
 
 
 def maybe_initialize_distributed(info: ClusterInfo) -> None:
-    """``jax.distributed.initialize`` — the tf.train.Server replacement."""
-    if info.is_distributed:
+    """``jax.distributed.initialize`` — the tf.train.Server replacement.
+
+    Idempotent: a second trainer run in the same process (tests, notebooks,
+    back-to-back ``main()`` calls) must reuse the live runtime — a repeat
+    ``initialize`` raises once the XLA backend exists."""
+    if info.is_distributed and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=info.coordinator_address,
             num_processes=info.num_processes,

@@ -68,6 +68,8 @@ def load_mnist(data_dir: str, split: str = "train",
     lbl_path = os.path.join(data_dir, lbl_name)
     if os.path.exists(img_path) or os.path.exists(img_path + ".gz"):
         return _read_idx_images(img_path), _read_idx_labels(lbl_path)
+    from distributedtensorflowexample_tpu.data.synthetic import warn_synthetic
+    warn_synthetic("MNIST", split, data_dir, img_name)
     num = synthetic_size or _SYNTH_SIZES[split]
     # Same class templates for both splits; disjoint sample draws — so a
     # model trained on "train" genuinely generalizes to "test".

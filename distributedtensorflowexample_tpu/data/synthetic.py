@@ -10,7 +10,30 @@ implicit run-to-verify convergence checks meaningful without the real bytes.
 
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
+
+_warned: set[tuple[str, str]] = set()
+
+
+def warn_synthetic(dataset: str, split: str, data_dir: str,
+                   expected: str) -> None:
+    """LOUD once-per-(dataset,split) notice that a real-data path fell back
+    to the synthetic distribution — accuracies from such runs are NOT
+    comparable to the reference's real-dataset numbers (round-2 verdict:
+    the silent fallback made every recorded accuracy ambiguous).
+    Suppress with DISTTF_TPU_QUIET_SYNTHETIC=1 (CI noise control)."""
+    if os.environ.get("DISTTF_TPU_QUIET_SYNTHETIC") == "1":
+        return     # before _warned.add: quiet mode must not consume the
+    if (dataset, split) in _warned:     # once-per-process warning
+        return
+    _warned.add((dataset, split))
+    print(f"WARNING: {dataset} {split!r} bytes not found in {data_dir!r} "
+          f"(expected {expected}); using the DETERMINISTIC SYNTHETIC "
+          f"fallback split. Accuracy targets for the real dataset do not "
+          f"apply — see README 'Real datasets'.", file=sys.stderr, flush=True)
 
 
 def make_synthetic(num: int, shape: tuple[int, ...], num_classes: int,

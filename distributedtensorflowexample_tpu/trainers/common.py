@@ -47,6 +47,38 @@ def _load_dataset(cfg: RunConfig, name: str, split: str):
     raise ValueError(f"unknown dataset {name!r}")
 
 
+def _refuse_incompatible_restore(saved: dict | None, current: dict,
+                                 log_dir: str, is_chief: bool) -> None:
+    """Named refusal for structurally-incompatible restores (reference
+    parity: a Saver restore into a mismatched graph also failed — ours
+    names the topology fact instead of an Orbax shape error).  ``saved``
+    is None for pre-metadata checkpoints: restore proceeds, Orbax itself
+    still catches true layout mismatches."""
+    if not saved:
+        return
+    if saved.get("sync_mode", current["sync_mode"]) != current["sync_mode"]:
+        raise ValueError(
+            f"checkpoint in {log_dir}/checkpoints was written by a "
+            f"sync_mode={saved['sync_mode']!r} run; restoring it into "
+            f"sync_mode={current['sync_mode']!r} would mismatch the state "
+            f"layout (worker-tiled vs replicated). Use a fresh --log_dir "
+            f"or rerun with --sync_mode={saved['sync_mode']}")
+    if (saved.get("num_workers") is not None
+            and saved["num_workers"] != current["num_workers"]):
+        raise ValueError(
+            f"checkpoint in {log_dir}/checkpoints holds async worker-tiled "
+            f"state for num_workers={saved['num_workers']}; this run has "
+            f"num_workers={current['num_workers']} (mesh size "
+            f"{current['mesh_size']}). The leading worker axis is "
+            f"structural — resume on {saved['num_workers']} devices or "
+            f"start fresh with a new --log_dir")
+    if (is_chief and saved.get("mesh_size") is not None
+            and saved["mesh_size"] != current["mesh_size"]):
+        print(f"note: resuming a mesh_size={saved['mesh_size']} checkpoint "
+              f"on mesh_size={current['mesh_size']} (fine for sync mode: "
+              f"state is replicated)", flush=True)
+
+
 def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                  augment: bool = False) -> dict:
     """Train per config; returns a summary dict (used by tests and bench)."""
@@ -112,20 +144,20 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                            is_chief=is_chief, log_every=cfg.log_every)
     hooks = []
     manager = None
+    # Topology facts of THIS run, persisted next to the checkpoints so a
+    # later resume can be refused by name instead of dying on an Orbax
+    # shape mismatch (async state is worker-tiled: leading axis =
+    # num_workers, so worker count is structural; sync state is replicated
+    # and restores fine across mesh sizes — recorded but not refused).
+    run_meta = {"sync_mode": cfg.sync_mode, "mesh_size": num_replicas,
+                "num_workers": num_replicas if is_async else None}
     if cfg.checkpoint_every > 0 or cfg.resume:
         manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
                                     max_to_keep=cfg.keep_checkpoints,
-                                    run_metadata={"sync_mode": cfg.sync_mode})
+                                    run_metadata=run_meta)
         if cfg.resume and manager.latest_step() is not None:
-            saved = manager.saved_run_metadata()
-            if saved and saved.get("sync_mode", cfg.sync_mode) != cfg.sync_mode:
-                raise ValueError(
-                    f"checkpoint in {cfg.log_dir}/checkpoints was written by "
-                    f"a sync_mode={saved['sync_mode']!r} run; restoring it "
-                    f"into sync_mode={cfg.sync_mode!r} would mismatch the "
-                    f"state layout (worker-tiled vs replicated). Use a fresh "
-                    f"--log_dir or rerun with --sync_mode="
-                    f"{saved['sync_mode']}")
+            _refuse_incompatible_restore(manager.saved_run_metadata(),
+                                         run_meta, cfg.log_dir, is_chief)
             state = manager.restore(state)
             if is_chief:
                 print(f"resumed from checkpoint at step {int(state.step)}",

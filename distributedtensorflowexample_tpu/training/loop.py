@@ -70,11 +70,24 @@ class TrainLoop:
                         f"exit hooks (final checkpoint) before exiting")
             interrupted = e
         # Drain outstanding device work so end-hooks (checkpoint) see final
-        # values and wall-clock accounting is honest.
-        if metrics is not None:
-            jax.block_until_ready(metrics)
+        # values and wall-clock accounting is honest.  A second Ctrl-C
+        # landing here (or inside an end-hook) must not skip the remaining
+        # exit hooks — the final checkpoint is exactly what the user is
+        # about to lose — so catch, keep going, re-raise at the end.
+        try:
+            if metrics is not None:
+                jax.block_until_ready(metrics)
+        except KeyboardInterrupt as e:
+            interrupted = interrupted or e
         for h in self._hooks:
-            h.end(state)
+            try:
+                h.end(state)
+            except KeyboardInterrupt as e:
+                from distributedtensorflowexample_tpu.utils.logging import (
+                    chief_print)
+                chief_print("interrupt during exit hooks — still running "
+                            "remaining exit hooks before exiting")
+                interrupted = interrupted or e
         if interrupted is not None:
             raise interrupted
         return state

@@ -153,9 +153,37 @@ def test_bench_input_stages(capsys):
     assert all(l["value"] > 0 and l["vs_baseline"] > 0 for l in lines)
 
 
+def test_bench_profile_end_to_end(tiny_mnist, tmp_path, monkeypatch,
+                                  capsys):
+    """bench_profile.py (the on-chip ResNet attribution harness) runs its
+    full pipeline — both augment variants, flops probe, profiler trace,
+    roofline, attribution summary — on the virtual mesh, so breakage
+    surfaces in CI rather than mid-availability-window on the chip."""
+    import bench_profile
+    from distributedtensorflowexample_tpu.data import cifar10
+
+    monkeypatch.setattr(cifar10, "_SYNTH_SIZES",
+                        {"train": 256, "test": 128})
+    monkeypatch.setattr("sys.argv", [
+        "bench_profile.py", "--unroll", "2", "--steps", "4",
+        "--batch_per_chip", "4", "--trace_dir", str(tmp_path / "trace")])
+    bench_profile.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    by_metric = {l["metric"]: l for l in lines}
+    assert by_metric["resnet20_profile_augment"]["value"] > 0
+    assert by_metric["resnet20_profile_no_augment"]["value"] > 0
+    assert by_metric["resnet20_roofline"]["value"] > 0
+    assert by_metric["resnet20_profile_augment"]["detail"]["flops_per_step"]
+    traced = by_metric["resnet20_traced_window"]
+    assert traced["value"] > 0 and traced["detail"]["trace_bytes"] > 0
+    att = by_metric["resnet20_attribution"]["detail"]
+    assert "augment_share" in att and "input_dispatch_share" in att
+
+
 def test_main_emits_headline_when_backend_unreachable(monkeypatch, capsys):
-    """A mid-outage driver run must still print one valid headline line
-    pointing at the recorded manual run."""
+    """A mid-outage driver run must still print one valid headline line —
+    with the sentinel unit "unavailable" so it can never be read as a
+    measured 100% regression — pointing at the recorded manual run."""
     from distributedtensorflowexample_tpu import parallel
 
     def boom(*a, **k):
@@ -167,8 +195,59 @@ def test_main_emits_headline_when_backend_unreachable(monkeypatch, capsys):
     assert len(lines) == 1
     assert lines[0]["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
     assert lines[0]["value"] == 0.0
+    assert lines[0]["unit"] == "unavailable"
     assert "UNAVAILABLE" in lines[0]["detail"]["error"]
     assert "BENCH_manual_r02" in lines[0]["detail"]["see"]
+    assert lines[0]["detail"]["probe_attempts"]  # skip notice (cpu pin)
+
+
+def test_probe_skipped_when_cpu_pinned():
+    """The CPU-pinned test process must never spawn an axon-init
+    subprocess (conftest pins via jax.config, not JAX_PLATFORMS)."""
+    assert bench._cpu_pinned()
+    ok, attempts = bench._wait_for_backend()
+    assert ok and "skipped" in attempts[0]
+
+
+def test_probe_backend_subprocess(monkeypatch):
+    """_probe_backend runs real code in a real subprocess with a hard
+    timeout; exercise success, failure, and timeout via the probe code."""
+    monkeypatch.setattr(bench, "_PROBE_CODE", "print('PROBE_OK 1')")
+    ok, info = bench._probe_backend(timeout_s=30)
+    assert ok and "PROBE_OK" in info
+
+    monkeypatch.setattr(bench, "_PROBE_CODE",
+                        "raise RuntimeError('UNAVAILABLE: down')")
+    ok, info = bench._probe_backend(timeout_s=30)
+    assert not ok and "UNAVAILABLE" in info
+
+    monkeypatch.setattr(bench, "_PROBE_CODE", "import time; time.sleep(60)")
+    ok, info = bench._probe_backend(timeout_s=1)
+    assert not ok and "timed out" in info
+
+
+def test_wait_for_backend_retries_within_budget(monkeypatch):
+    """Failure path: retries on the interval, gives up inside the budget,
+    and returns the attempt log; success path: returns on first OK."""
+    monkeypatch.setattr(bench, "_cpu_pinned", lambda: False)
+    monkeypatch.setattr(bench, "RETRY_BUDGET_S", 10.0)
+    monkeypatch.setattr(bench, "RETRY_INTERVAL_S", 0.01)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 0.01)
+    calls = []
+
+    def probe(timeout_s=None):
+        calls.append(1)
+        return (len(calls) >= 3), f"attempt {len(calls)}"
+    monkeypatch.setattr(bench, "_probe_backend", probe)
+    ok, attempts = bench._wait_for_backend()
+    assert ok and len(calls) == 3 and len(attempts) == 3
+
+    calls.clear()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s=None: (False, "down"))
+    monkeypatch.setattr(bench, "RETRY_BUDGET_S", 0.05)
+    ok, attempts = bench._wait_for_backend()
+    assert not ok and attempts
 
 
 def test_collective_traffic_parsing():

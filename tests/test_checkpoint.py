@@ -229,6 +229,28 @@ def test_interrupt_still_checkpoints_final_state(tmp_path):
     mgr.close()
 
 
+def test_second_interrupt_during_exit_hooks_still_saves(tmp_path):
+    """A second Ctrl-C delivered inside the exit-hook pass (the ADVICE r2
+    residual window) must not skip the remaining exit hooks: the final
+    checkpoint still lands, then KeyboardInterrupt propagates."""
+
+    from distributedtensorflowexample_tpu.training.hooks import Hook
+
+    class InterruptOnEnd(Hook):
+        def end(self, state):
+            raise KeyboardInterrupt
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    # Interrupting hook runs FIRST so the save hook exercises the
+    # keep-going path.
+    loop = TrainLoop(make_train_step(), iter(_batches(4)), 2,
+                     hooks=[InterruptOnEnd(), CheckpointHook(mgr, every=0)])
+    with pytest.raises(KeyboardInterrupt):
+        loop.run(_fresh_state())
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+
 def test_run_metadata_roundtrip(tmp_path):
     d = str(tmp_path / "ckpt")
     mgr = CheckpointManager(d, run_metadata={"sync_mode": "sync"})
@@ -239,6 +261,40 @@ def test_run_metadata_roundtrip(tmp_path):
     # A second manager over the same dir reads the original writer's mode.
     again = CheckpointManager(d, run_metadata={"sync_mode": "async"})
     assert again.saved_run_metadata() == {"sync_mode": "sync"}
+
+
+def test_async_worker_count_restore_is_refused(tmp_path, small_synthetic):
+    """An async checkpoint is worker-tiled (leading axis = num_workers):
+    restoring it on a different worker count must fail with an error
+    naming both counts, not an Orbax shape mismatch (VERDICT r2 item 6)."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  log_every=50, seed=1, sync_mode="async", async_period=2)
+    run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,
+                           num_devices=2, **common), "softmax", "mnist")
+    with pytest.raises(ValueError, match="num_workers=2.*num_workers=4"):
+        run_training(RunConfig(train_steps=8, resume=True, num_devices=4,
+                               **common), "softmax", "mnist")
+
+
+def test_sync_mesh_size_restore_is_allowed(tmp_path, small_synthetic, capsys):
+    """Sync-mode state is replicated, so resuming on a different mesh size
+    is legitimate (scale-up resume); the guard notes it and proceeds."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  log_every=50, seed=1)
+    run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,
+                           num_devices=2, **common), "softmax", "mnist")
+    out = run_training(RunConfig(train_steps=8, resume=True, num_devices=4,
+                                 **common), "softmax", "mnist")
+    assert out["steps"] == 8
+    assert "resuming a mesh_size=2 checkpoint" in capsys.readouterr().out
 
 
 def test_cross_mode_restore_is_refused(tmp_path, small_synthetic):

@@ -35,6 +35,50 @@ def test_cifar_shapes(tmp_path):
     assert y.shape == (128,)
 
 
+def test_cifar_tar_layout_matches_pickle_dir(tmp_path):
+    """An unextracted cifar-10-python.tar.gz (the canonical download
+    artifact) loads bit-identically to the extracted pickle dir."""
+    import io
+    import pickle
+    import tarfile
+
+    rng = np.random.RandomState(3)
+    batches = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        batches[name] = {
+            b"data": rng.randint(0, 256, size=(10, 3072), dtype=np.uint8),
+            b"labels": rng.randint(0, 10, size=(10,)).tolist()}
+
+    pick_dir = tmp_path / "extracted" / "cifar-10-batches-py"
+    pick_dir.mkdir(parents=True)
+    tar_dir = tmp_path / "tarred"
+    tar_dir.mkdir()
+    with tarfile.open(tar_dir / "cifar-10-python.tar.gz", "w:gz") as tf:
+        for name, d in batches.items():
+            (pick_dir / name).write_bytes(pickle.dumps(d))
+            blob = pickle.dumps(d)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+    for split in ("train", "test"):
+        xd, yd = load_cifar10(str(tmp_path / "extracted"), split)
+        xt, yt = load_cifar10(str(tar_dir), split)
+        np.testing.assert_array_equal(xd, xt)
+        np.testing.assert_array_equal(yd, yt)
+    assert xd.shape == (10, 32, 32, 3)
+
+
+def test_cifar_corrupt_tar_falls_back(tmp_path, capsys):
+    """A truncated/corrupt tarball (interrupted download) must behave like
+    any other absent dataset — warn and fall back, not crash training."""
+    (tmp_path / "cifar-10-python.tar.gz").write_bytes(b"definitely not a tar")
+    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=32)
+    assert x.shape == (32, 32, 32, 3)
+    # stderr, NOT stdout — bench consumers json-parse every stdout line.
+    assert "ignoring unreadable" in capsys.readouterr().err
+
+
 def test_cifar_augment_shapes():
     rng = np.random.RandomState(0)
     x = rng.rand(8, 32, 32, 3).astype(np.float32)

@@ -18,19 +18,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_workers(script_template: str, tmp_path) -> list[str]:
+def _run_two_workers(script_template: str, tmp_path,
+                     devices_per_proc: int = 1,
+                     timeout: int = 280) -> list[str]:
     """Launch 2 OS worker processes with a reference-style TF_CONFIG, wait
-    for both, assert both exited 0, and return their outputs."""
+    for both, assert both exited 0, and return their outputs.
+    ``devices_per_proc`` > 1 gives each process that many virtual CPU
+    devices (the N-process x M-device topology of VERDICT r2 item 4)."""
     workers = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
     procs = []
     for idx in range(2):
         env = dict(os.environ)
         env["PALLAS_AXON_POOL_IPS"] = ""   # skip axon TPU registration
+        env["JAX_NUM_CPU_DEVICES"] = str(devices_per_proc)
         env["TF_CONFIG"] = (
             '{"cluster": {"worker": ["%s", "%s"]}, '
             '"task": {"type": "worker", "index": %d}}'
             % (workers[0], workers[1], idx))
-        script = script_template.format(logdir=str(tmp_path / f"w{idx}"))
+        script = script_template.format(logdir=str(tmp_path / f"w{idx}"),
+                                        ndev=devices_per_proc)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script],
             env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
@@ -38,7 +44,7 @@ def _run_two_workers(script_template: str, tmp_path) -> list[str]:
     outputs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=280)
+            out, _ = p.communicate(timeout=timeout)
             outputs.append(out)
     finally:
         for p in procs:   # never leak workers if one hangs
@@ -149,4 +155,113 @@ def test_two_process_resident_eval_matches_host_eval(tmp_path):
     processes — a wrong local slice shows up as a different accuracy."""
     outputs = _run_two_workers(_EVAL_SCRIPT, tmp_path)
     for out in outputs:
+        assert "EVAL_OK" in out, out
+
+
+# ---- N processes x M devices/process (VERDICT r2 item 4) ----------------
+# All round-2 multihost coverage ran 2 procs x 1 device; the device-order
+# assumptions (put_global_batch's contiguous row-range per process,
+# make_resident_eval's per-process column slices, async worker tiling
+# spanning processes) only bite when M > 1.
+
+_NXM_TRAIN_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {ndev})
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+from distributedtensorflowexample_tpu.data import mnist
+mnist._SYNTH_SIZES = {{"train": 256, "test": 128}}
+from distributedtensorflowexample_tpu.trainers import (
+    trainer_ps_mnist, trainer_sync_mnist)
+common = ["--train_steps", "4", "--batch_size", "8", "--global_batch",
+          "true", "--data_dir", "/nonexistent", "--resume", "false",
+          "--log_every", "2", "--learning_rate", "0.05"]
+s = trainer_sync_mnist.main(
+    common + ["--steps_per_loop", "2", "--log_dir", {logdir!r} + "/sync"])
+print("SYNC steps=%d replicas=%d acc=%.6f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+s = trainer_sync_mnist.main(
+    common + ["--device_data", "off", "--log_dir", {logdir!r} + "/host"])
+print("HOSTFED steps=%d replicas=%d acc=%.6f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+s = trainer_ps_mnist.main(
+    common + ["--steps_per_loop", "2", "--async_period", "2",
+              "--log_dir", {logdir!r} + "/async"])
+print("ASYNC steps=%d replicas=%d acc=%.6f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+"""
+
+
+def test_nxm_training_all_modes(tmp_path):
+    """2 procs x 4 devices: sync device-resident, sync host-fed
+    (Batcher + put_local_batch), and async local-SGD (8 worker tiles
+    spanning 2 processes) all train and agree bitwise across processes."""
+    # 3 trainings x several compiles per worker: give the launch the time
+    # budget of three ordinary multihost tests.
+    outputs = _run_two_workers(_NXM_TRAIN_SCRIPT, tmp_path,
+                               devices_per_proc=4, timeout=840)
+    for tag in ("SYNC", "HOSTFED", "ASYNC"):
+        lines = [l for out in outputs for l in out.splitlines()
+                 if l.startswith(tag + " ")]
+        assert len(lines) == 2, outputs
+        assert all("steps=4 replicas=8" in l for l in lines), lines
+        accs = {l.split("acc=")[1] for l in lines}
+        assert len(accs) == 1, f"{tag} diverged across processes: {lines}"
+
+
+_NXM_EVAL_SCRIPT = """
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {ndev})
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+from distributedtensorflowexample_tpu import cluster
+from distributedtensorflowexample_tpu.config import RunConfig
+info = cluster.resolve(RunConfig())            # TF_CONFIG from the env
+cluster.maybe_initialize_distributed(info)
+import optax
+from distributedtensorflowexample_tpu.data import mnist
+mnist._SYNTH_SIZES = {{"train": 512, "test": 256}}
+from distributedtensorflowexample_tpu.data.mnist import load_mnist
+from distributedtensorflowexample_tpu.data.pipeline import put_global_batch
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.parallel import (
+    batch_sharding, make_mesh, replicated_sharding)
+from distributedtensorflowexample_tpu.parallel.sync import (
+    evaluate, make_resident_eval)
+from distributedtensorflowexample_tpu.training.state import TrainState
+mesh = make_mesh()
+assert mesh.size == 2 * {ndev} and jax.process_count() == 2
+
+# put_global_batch: every process holds the same global array; each of the
+# 2*M shards must get exactly its global row-range (the contiguous
+# row-range-per-process assumption).
+x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+arr = put_global_batch({{"v": x}}, batch_sharding(mesh))["v"]
+for shard in arr.addressable_shards:
+    np.testing.assert_array_equal(np.asarray(shard.data), x[shard.index])
+print("PUT_GLOBAL_OK")
+
+xs, ys = load_mnist("/nonexistent", "test")
+state = TrainState.create_sharded(build_model("softmax"), optax.sgd(0.1),
+                                  (64, 28, 28, 1), 3,
+                                  replicated_sharding(mesh))
+with mesh:
+    host = evaluate(state, xs, ys, batch_size=64,
+                    sharding=batch_sharding(mesh))
+    res = make_resident_eval(xs, ys, batch_size=64, mesh=mesh)(state)
+print("EVALS host=%.6f resident=%.6f" % (host, res))
+assert abs(host - res) < 1e-9, (host, res)
+print("EVAL_OK {logdir}")
+"""
+
+
+def test_nxm_put_global_batch_and_resident_eval(tmp_path):
+    """2 procs x 4 devices: put_global_batch's per-shard rows are exactly
+    the global row-ranges, and the resident eval's column slices reproduce
+    the host-fed evaluate bitwise."""
+    outputs = _run_two_workers(_NXM_EVAL_SCRIPT, tmp_path,
+                               devices_per_proc=4, timeout=560)
+    for out in outputs:
+        assert "PUT_GLOBAL_OK" in out, out
         assert "EVAL_OK" in out, out

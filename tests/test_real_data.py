@@ -1,0 +1,123 @@
+"""Real-dataset accuracy gates (VERDICT r2 item 5).
+
+Every accuracy this repo has ever recorded ran on the synthetic fallback
+(this image ships no MNIST/CIFAR bytes and has no network).  These tests
+pin the reference's implicit real-data convergence contract — MNIST
+softmax >=0.92, MNIST CNN >=0.97, CIFAR-10 ResNet-20 >=0.90 — and SKIP
+unless the real bytes are present under DISTTF_TPU_DATA_DIR (default
+/tmp/data).  One-command fetch + expected layout: README 'Real datasets'.
+
+The loaders themselves shout when they fall back (warn_synthetic), so a
+run that silently trained on synthetic data can no longer be mistaken for
+a real-data result.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributedtensorflowexample_tpu.data.mnist import _FILES
+
+DATA_DIR = os.environ.get("DISTTF_TPU_DATA_DIR", "/tmp/data")
+
+
+def _mnist_present() -> bool:
+    img, _ = _FILES["train"]
+    p = os.path.join(DATA_DIR, img)
+    return os.path.exists(p) or os.path.exists(p + ".gz")
+
+
+def _cifar_present() -> bool:
+    """Stat-based only — a full _load_binary_batches here would parse the
+    ~150 MB train split at pytest collection time on every run."""
+    for sub in ("", "cifar-10-batches-py", "cifar-10-batches-bin"):
+        d = os.path.join(DATA_DIR, sub)
+        if os.path.isdir(d) and any(
+                n.startswith("data_batch") for n in os.listdir(d)):
+            return True
+    return any(os.path.exists(os.path.join(DATA_DIR, t))
+               for t in ("cifar-10-python.tar.gz", "cifar-10-python.tar"))
+
+
+needs_mnist = pytest.mark.skipif(
+    not _mnist_present(),
+    reason=f"real MNIST IDX files not present in {DATA_DIR} "
+           "(see README 'Real datasets' for the one-command fetch)")
+needs_cifar = pytest.mark.skipif(
+    not _cifar_present(),
+    reason=f"real CIFAR-10 batches not present in {DATA_DIR} "
+           "(see README 'Real datasets' for the one-command fetch)")
+
+
+def _flags(tmp_log_dir, extra):
+    return ["--log_dir", tmp_log_dir, "--data_dir", DATA_DIR,
+            "--resume", "false", "--log_every", "200", *extra]
+
+
+@needs_mnist
+def test_real_mnist_softmax_accuracy(tmp_log_dir):
+    """Config-1 contract on the real bytes: softmax regression >=0.92."""
+    from distributedtensorflowexample_tpu.trainers import trainer_local_mnist
+
+    summary = trainer_local_mnist.main(_flags(
+        tmp_log_dir, ["--train_steps", "2000", "--batch_size", "100",
+                      "--learning_rate", "0.5"]))
+    assert summary["final_accuracy"] >= 0.92, summary
+
+
+@needs_mnist
+def test_real_mnist_cnn_accuracy(tmp_log_dir):
+    """Config-3 contract on the real bytes: the conv/pool x2 + FC CNN
+    reaches >=0.97 within ~4 epochs."""
+    from distributedtensorflowexample_tpu.trainers import trainer_sync_mnist
+
+    summary = trainer_sync_mnist.main(_flags(
+        tmp_log_dir, ["--train_steps", "3000", "--batch_size", "64",
+                      "--learning_rate", "0.05", "--momentum", "0.9",
+                      "--steps_per_loop", "50"]))
+    assert summary["final_accuracy"] >= 0.97, summary
+
+
+@needs_cifar
+@pytest.mark.slow
+def test_real_cifar_resnet20_accuracy(tmp_log_dir):
+    """Config-4 contract on the real bytes: ResNet-20 + augmentation +
+    cosine schedule >=0.90 (canonical recipe lands ~0.91 around epoch 60;
+    hours on CPU — run on the chip)."""
+    from distributedtensorflowexample_tpu.trainers import (
+        trainer_mirrored_cifar)
+
+    steps = 60 * (50000 // 256)   # ~60 epochs at global batch 256
+    summary = trainer_mirrored_cifar.main(_flags(
+        tmp_log_dir, ["--train_steps", str(steps), "--batch_size", "256",
+                      "--global_batch", "true", "--learning_rate", "0.1",
+                      "--momentum", "0.9", "--weight_decay", "5e-4",
+                      "--lr_schedule", "cosine", "--warmup_steps", "400",
+                      "--steps_per_loop", "65"]))
+    assert summary["final_accuracy"] >= 0.90, summary
+
+
+def test_synthetic_fallback_warns_once(tmp_path, capfd):
+    """The fallback is LOUD (stderr) and once per (dataset, split)."""
+    from distributedtensorflowexample_tpu.data import synthetic
+    from distributedtensorflowexample_tpu.data.mnist import load_mnist
+
+    synthetic._warned.clear()
+    load_mnist(str(tmp_path), "train", synthetic_size=64)
+    load_mnist(str(tmp_path), "train", synthetic_size=64)   # deduped
+    load_mnist(str(tmp_path), "test", synthetic_size=64)    # new split
+    err = capfd.readouterr().err
+    assert err.count("DETERMINISTIC SYNTHETIC") == 2
+    assert "MNIST 'train' bytes not found" in err
+
+
+def test_synthetic_fallback_warning_suppressible(tmp_path, capfd,
+                                                 monkeypatch):
+    from distributedtensorflowexample_tpu.data import synthetic
+    from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
+
+    synthetic._warned.clear()
+    monkeypatch.setenv("DISTTF_TPU_QUIET_SYNTHETIC", "1")
+    load_cifar10(str(tmp_path), "train", synthetic_size=64)
+    assert "SYNTHETIC" not in capfd.readouterr().err

@@ -129,3 +129,35 @@ def test_rate_meter():
 def test_chief_print(capsys):
     chief_print("hello-chief")
     assert "hello-chief" in capsys.readouterr().out
+
+
+def test_conftest_xla_flags_accepted_by_backend():
+    """An UNKNOWN name in XLA_FLAGS fatally aborts the process at first
+    backend init, and pytest capture eats the `F... Unknown flag` log —
+    the whole suite dies with rc=1 and ZERO output (round-3 incident:
+    a plausible-but-wrong flag rename killed every device test silently).
+    Pin that the conftest's exact flag string is known to this jaxlib by
+    touching a collective in a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "jax.config.update('jax_num_cpu_devices', 2);"
+        "import numpy as np; import jax.numpy as jnp;"
+        "from jax.sharding import Mesh, PartitionSpec as P;"
+        "m = Mesh(np.array(jax.devices()), ('d',));"
+        "f = jax.shard_map(lambda x: jax.lax.psum(x, 'd'), mesh=m,"
+        "                  in_specs=P('d'), out_specs=P());"
+        "print('FLAGS_OK', float(f(jnp.ones(4))[0]))"
+    )
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""   # no axon in the subprocess
+    assert "--xla_cpu_collective_call" in env.get("XLA_FLAGS", ""), \
+        "conftest did not install the rendezvous flags"
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "FLAGS_OK" in r.stdout
+    assert "Unknown flag" not in r.stderr
