@@ -43,6 +43,13 @@ def _emit(metric: str, value: float, detail: dict) -> None:
                       "detail": detail}), flush=True)
 
 
+# ResNet-20 at CIFAR shapes is bandwidth-bound (arithmetic intensity
+# ~4 FLOP/B vs the v5e ridge ~240), so the honest roofline is
+# min(peak_flops/F, hbm_bw/B) — the MFU number alone misattributes a
+# bandwidth ceiling as 'low utilization'.  Cost probing shares bench's
+# one implementation (bench._cost_per_step).
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--unroll", type=int, default=195,
@@ -69,17 +76,29 @@ def main() -> None:
             errors[name] = repr(e)
             traceback.print_exc()
 
+    HBM_BW = float(os.environ.get("TPU_HBM_BW", 819e9))   # v5e bytes/s
+
     def run_variant(tag, aug):
         step, ds, state, u = bench._make(
             "resnet20", "cifar10", args.batch_per_chip, args.unroll,
             mesh, augment=aug, lr=0.1)
-        flops = bench._flops_per_step(step, state, ds.peek(), u)
+        cost = bench._cost_per_step(step, state, ds.peek(), u)
         best, reps, state = bench._measure(step, ds, state, args.steps, u)
         rates[tag] = best
-        mfu = (flops * best / n / bench.PEAK_FLOPS) if flops else None
-        _emit(f"resnet20_profile_{tag}", best / n,
-              {"repeats": reps, "unroll": u, "flops_per_step": flops,
-               "mfu": round(mfu, 5) if mfu else None})
+        flops, nbytes = cost.get("flops"), cost.get("bytes_accessed")
+        detail = {"repeats": reps, "unroll": u, "flops_per_step": flops,
+                  "bytes_per_step": nbytes}
+        if flops:
+            detail["mfu"] = round(flops * best / n / bench.PEAK_FLOPS, 5)
+        if flops and nbytes:
+            # Compute-vs-bandwidth attribution: which wall does this
+            # program's arithmetic intensity put it against?
+            detail["arith_intensity_flop_per_byte"] = round(
+                flops / nbytes, 2)
+            detail["bw_roofline_steps_per_sec"] = round(HBM_BW / nbytes, 1)
+            detail["mfu_ceiling_at_bw"] = round(
+                (HBM_BW / nbytes) * flops / bench.PEAK_FLOPS, 5)
+        _emit(f"resnet20_profile_{tag}", best / n, detail)
         return step, ds, state, u
 
     with mesh:

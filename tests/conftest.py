@@ -51,6 +51,15 @@ if os.environ.get("DISTTF_INNER_PYTEST") != "1":
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent compilation cache: the suite is compile-dominated (dozens of
+# jit programs, recompiled from scratch in every isolated subprocess —
+# tests/test_isolated.py), and this 1-core host pays ~30-80 s per big
+# compile under load.  The cache is keyed by HLO+flags+topology, so the
+# 8-virtual-device programs hit across inner runs and across consecutive
+# suite runs.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DISTTF_JAX_CACHE", "/tmp/jax_cache_tests"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # Synchronous CPU dispatch: a deep async queue of collective programs
 # multiplies the concurrent-thread demand and with it the starvation
 # window.  Purely a test-environment knob — the TPU runtime throttles its
