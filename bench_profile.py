@@ -58,6 +58,10 @@ def main() -> None:
     ap.add_argument("--batch_per_chip", type=int, default=256)
     ap.add_argument("--trace_dir", default="/tmp/resnet_trace")
     ap.add_argument("--skip_trace", action="store_true")
+    ap.add_argument("--roofline_length", type=int, default=128,
+                    help="scanned steps per roofline repeat (CI shrinks "
+                         "this: 128 ResNet steps x 4 runs take tens of "
+                         "minutes on the virtual CPU mesh)")
     args = ap.parse_args()
 
     from distributedtensorflowexample_tpu.parallel import make_mesh
@@ -144,7 +148,8 @@ def main() -> None:
 
         def run_roofline():
             roof = bench._roofline_probe(mesh, args.batch_per_chip,
-                                         length=128, model_name="resnet20",
+                                         length=args.roofline_length,
+                                         model_name="resnet20",
                                          sample=(32, 32, 3), lr=0.1)
             rates["roofline"] = max(roof)
             _emit("resnet20_roofline", max(roof) / n, {"repeats": roof})

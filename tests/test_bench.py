@@ -166,7 +166,8 @@ def test_bench_profile_end_to_end(tiny_mnist, tmp_path, monkeypatch,
                         {"train": 256, "test": 128})
     monkeypatch.setattr("sys.argv", [
         "bench_profile.py", "--unroll", "2", "--steps", "4",
-        "--batch_per_chip", "4", "--trace_dir", str(tmp_path / "trace")])
+        "--batch_per_chip", "4", "--roofline_length", "4",
+        "--trace_dir", str(tmp_path / "trace")])
     bench_profile.main()
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     by_metric = {l["metric"]: l for l in lines}

@@ -138,7 +138,7 @@ def test_checkpoint_hook_saves_periodically_and_at_end(tmp_path):
 def test_restore_preserves_sharding(tmp_path):
     """Restoring into a mesh-sharded template keeps the NamedSharding —
     the multi-host-safe path (every process restores its own shards)."""
-    mesh = make_mesh(8)
+    mesh = make_mesh()
     model = build_model("softmax")
     repl = replicated_sharding(mesh)
     state = TrainState.create_sharded(model, optax.sgd(0.1),

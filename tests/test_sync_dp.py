@@ -31,9 +31,10 @@ def _batch(mesh, n=64, shape=(28, 28, 1), seed=0):
     return jax.device_put({"image": x, "label": y}, batch_sharding(mesh))
 
 
-def test_eight_device_mesh():
+def test_virtual_device_mesh():
     mesh = make_mesh()
-    assert mesh.size == 8
+    assert mesh.size == jax.device_count()
+    assert jax.device_count() >= 4   # DISTTF_TEST_DEVICES retry floor
 
 
 def test_train_step_runs_sharded():
@@ -52,8 +53,9 @@ def test_train_step_runs_sharded():
 def test_batch_is_actually_sharded():
     mesh = make_mesh()
     batch = _batch(mesh)
-    assert len(batch["image"].sharding.device_set) == 8
-    assert batch["image"].addressable_shards[0].data.shape[0] == 64 // 8
+    assert len(batch["image"].sharding.device_set) == mesh.size
+    assert (batch["image"].addressable_shards[0].data.shape[0]
+            == 64 // mesh.size)
 
 
 def test_loss_decreases_under_dp():
@@ -72,11 +74,12 @@ def test_loss_decreases_under_dp():
 
 
 def test_one_vs_eight_device_equivalence():
-    """Same global batch ⇒ numerically identical update on 1 and 8 devices:
-    the determinism guarantee the reference's sync mode only approximated."""
+    """Same global batch ⇒ numerically identical update on 1 and all
+    visible devices: the determinism guarantee the reference's sync mode
+    only approximated."""
     step = make_train_step()
     results = []
-    for ndev in (1, 8):
+    for ndev in (1, jax.device_count()):
         mesh = make_mesh(ndev)
         state = _make_state("softmax", (64, 28, 28, 1), mesh, lr=0.5, seed=7)
         for i in range(3):
