@@ -154,6 +154,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     if cfg.checkpoint_every > 0 or cfg.resume:
         manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
                                     max_to_keep=cfg.keep_checkpoints,
+                                    async_save=cfg.async_checkpoint,
                                     run_metadata=run_meta)
         if cfg.resume and manager.latest_step() is not None:
             _refuse_incompatible_restore(manager.saved_run_metadata(),
