@@ -310,16 +310,23 @@ def main() -> None:
     the HEADLINE, which is always the last line emitted."""
     from distributedtensorflowexample_tpu.parallel import make_mesh
 
-    def emit_unavailable(why: str, attempts: list) -> None:
+    def emit_unavailable(why: str, attempts: list,
+                         errors: dict | None = None) -> None:
         # Sentinel, NOT a measurement: unit "unavailable" + value 0.0 so
         # no consumer can mistake the line for a measured 100% regression
         # (round 2's 0.0 steps/sec/chip line read exactly that way).
+        detail = {"error": why[:500], "probe_attempts": attempts[-8:],
+                  "see": "BENCH_manual_r02.json (full on-chip run, "
+                         "2026-07-30) and BASELINE.md"}
+        if errors:
+            # Attached structurally (not serialized into a truncated
+            # string) so the headline sweep's own per-point errors — the
+            # LAST dict entries — can't be cut off by earlier workloads'.
+            detail["errors"] = {k: v[:300] for k, v in errors.items()}
         print(json.dumps({
             "metric": "mnist_cnn_sync_steps_per_sec_per_chip",
             "value": 0.0, "unit": "unavailable", "vs_baseline": 0.0,
-            "detail": {"error": why[:500], "probe_attempts": attempts[-8:],
-                       "see": "BENCH_manual_r02.json (full on-chip run, "
-                              "2026-07-30) and BASELINE.md"},
+            "detail": detail,
         }), flush=True)
 
     reachable, attempts = _wait_for_backend()
@@ -450,6 +457,18 @@ def main() -> None:
             {16, spe, 4 * spe, 8 * spe, 16 * spe},
             lambda unroll: _make("mnist_cnn", "mnist", 256, unroll, mesh),
             lambda u: max(512, u * 4), "sweep_", errors)
+        if best_unroll is None:
+            # Every headline point failed — the backend died AFTER the
+            # initial probe succeeded (mid-run outage, the round-3 03:49
+            # UTC capture's exact failure shape).  A 0.0 steps/sec/chip
+            # line would read as a measured 100% regression, so emit the
+            # same explicit sentinel the up-front probe failure uses.
+            emit_unavailable(
+                "every headline sweep point failed (no measurement; "
+                "mid-run backend loss is the known cause of this shape, "
+                "but read detail.errors for the actual per-point failures)",
+                attempts, errors)
+            return
         detail = {"repeats": best_rates, "best_unroll": best_unroll,
                   "unroll_sweep": sweep, "batch_per_chip": 256}
         attach_roofline(detail, best_overall, "roofline", 256)

@@ -202,6 +202,30 @@ def test_main_emits_headline_when_backend_unreachable(monkeypatch, capsys):
     assert lines[0]["detail"]["probe_attempts"]  # skip notice (cpu pin)
 
 
+def test_main_emits_sentinel_when_backend_dies_mid_run(monkeypatch, capsys):
+    """Round-3 failure shape: the up-front probe succeeds, then the tunnel
+    dies DURING the run so every sweep point fails.  The headline must be
+    the explicit unavailable sentinel (not a measured-looking 0.0), with
+    the per-point errors attached for diagnosis."""
+    def boom(*a, **k):
+        raise RuntimeError("UNAVAILABLE: remote_compile connection refused")
+
+    monkeypatch.setattr(bench, "_make", boom)
+    monkeypatch.setattr(bench, "_roofline_probe", boom)
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 1           # no workload line, ONE sentinel
+    line = lines[0]
+    assert line["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    assert line["unit"] == "unavailable" and line["value"] == 0.0
+    assert "every headline sweep point failed" in line["detail"]["error"]
+    # The HEADLINE sweep's own per-point errors must survive (sweep_16 is
+    # a headline key; resnet's are prefixed resnet_sweep_) alongside the
+    # earlier workloads' errors.
+    assert "sweep_16" in line["detail"]["errors"]
+    assert any(k.startswith("resnet_sweep_") for k in line["detail"]["errors"])
+
+
 def test_probe_skipped_when_cpu_pinned():
     """The CPU-pinned test process must never spawn an axon-init
     subprocess (conftest pins via jax.config, not JAX_PLATFORMS)."""
