@@ -28,6 +28,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -45,6 +46,19 @@ PEAK_FLOPS = float(os.environ.get("TPU_PEAK_FLOPS", 197e12))  # v5e bf16
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300))
 RETRY_INTERVAL_S = float(os.environ.get("BENCH_RETRY_INTERVAL_S", 240))
 RETRY_BUDGET_S = float(os.environ.get("BENCH_RETRY_BUDGET_S", 2400))
+
+# Hard wall-clock budget for the measurement phase itself.  Round 3
+# measured the remaining failure mode the probe can't catch: the backend
+# died ~5 min AFTER a successful probe and the next jit call blocked
+# >60 min without raising — a driver run stuck that way records nothing
+# at all, which is strictly worse than the sentinel.  A watchdog THREAD
+# works here because XLA compile/execute calls release the GIL while
+# blocked; on expiry it emits the sentinel headline (the per-workload
+# lines already printed remain valid — each is flushed as it completes)
+# and hard-exits.  os._exit is deliberate: the main thread is wedged
+# inside a C++ call that will never return, so normal interpreter
+# shutdown would block on it forever.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
 
 # The probe must FAIL on a silent fall-back-to-CPU init (jax can degrade
 # with only a warning): a CPU measurement published as steps/sec/chip is
@@ -118,6 +132,33 @@ def _wait_for_backend() -> tuple[bool, list]:
         if time.time() + RETRY_INTERVAL_S + PROBE_TIMEOUT_S > deadline:
             return False, attempts
         time.sleep(RETRY_INTERVAL_S)
+
+
+def _arm_watchdog(budget_s: float, fire, _exit=os._exit) -> threading.Event:
+    """Daemon timer that calls ``fire()`` and hard-exits (code 3) if the
+    returned Event isn't set within ``budget_s``.  Covers the failure the
+    probe can't: a jit call that blocks forever after the backend dies
+    mid-run (XLA compile/execute releases the GIL, so this thread runs
+    while the main thread is wedged in C++).  ``os._exit`` because normal
+    shutdown would join the wedged call; by the time the watchdog fires
+    the tunnel is already gone, so the skip-atexit exit can't wedge a
+    healthy chip."""
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(budget_s):
+            try:
+                fire()
+                sys.stdout.flush()
+            finally:
+                # The exit must survive a failing fire() (e.g. stdout
+                # gone, or a dict mutated mid-serialization): a watchdog
+                # that dies before exiting recreates the silent hang it
+                # exists to prevent.
+                _exit(3)
+
+    threading.Thread(target=watch, daemon=True, name="bench-watchdog").start()
+    return done
 
 
 def _load_baselines() -> dict:
@@ -316,13 +357,16 @@ def main() -> None:
         # no consumer can mistake the line for a measured 100% regression
         # (round 2's 0.0 steps/sec/chip line read exactly that way).
         detail = {"error": why[:500], "probe_attempts": attempts[-8:],
-                  "see": "BENCH_manual_r02.json (full on-chip run, "
-                         "2026-07-30) and BASELINE.md"}
+                  "see": "BENCH_early_r03.json (round-3 early capture), "
+                         "BENCH_manual_r02.json (full on-chip run, "
+                         "2026-07-30), and BASELINE.md"}
         if errors:
             # Attached structurally (not serialized into a truncated
             # string) so the headline sweep's own per-point errors — the
             # LAST dict entries — can't be cut off by earlier workloads'.
-            detail["errors"] = {k: v[:300] for k, v in errors.items()}
+            # list() snapshots first: the watchdog thread may serialize
+            # while the main thread is still appending.
+            detail["errors"] = {k: v[:300] for k, v in list(errors.items())}
         print(json.dumps({
             "metric": "mnist_cnn_sync_steps_per_sec_per_chip",
             "value": 0.0, "unit": "unavailable", "vs_baseline": 0.0,
@@ -335,14 +379,25 @@ def main() -> None:
             "TPU backend unreachable after probe retries "
             f"(budget {RETRY_BUDGET_S:.0f}s)", attempts)
         return
+    errors: dict = {}
+    # Armed BEFORE the in-process init: make_mesh is the next backend
+    # touch and itself blocks 25-45 min if the backend died after the
+    # probe succeeded.  Disarmed immediately after the headline emit.
+    # If it fires, the sentinel IS the last line (per-workload lines
+    # already printed stay valid — each was flushed as it completed).
+    watchdog_done = _arm_watchdog(TOTAL_BUDGET_S, lambda: emit_unavailable(
+        f"watchdog: measurement phase exceeded {TOTAL_BUDGET_S:.0f}s — a "
+        "call blocked without raising (backend presumed lost mid-run); "
+        "any lines above are valid completed measurements",
+        attempts, errors))
     try:
         mesh = make_mesh()
     except Exception as e:
         emit_unavailable(f"TPU backend unavailable: {e!r}", attempts)
+        watchdog_done.set()
         return
     num_chips = mesh.size
     baselines = _load_baselines()
-    errors: dict = {}
 
     def attempt(name, fn):
         try:
@@ -468,6 +523,7 @@ def main() -> None:
                 "mid-run backend loss is the known cause of this shape, "
                 "but read detail.errors for the actual per-point failures)",
                 attempts, errors)
+            watchdog_done.set()
             return
         detail = {"repeats": best_rates, "best_unroll": best_unroll,
                   "unroll_sweep": sweep, "batch_per_chip": 256}
@@ -476,6 +532,9 @@ def main() -> None:
             detail["errors"] = errors
         _emit("mnist_cnn_sync_steps_per_sec_per_chip",
               best_overall / num_chips, baselines, detail)
+        # Disarm right at the emit (not after mesh.__exit__): a budget
+        # lapse in the gap would append a sentinel AFTER a valid headline.
+        watchdog_done.set()
 
 
 if __name__ == "__main__":

@@ -226,6 +226,55 @@ def test_main_emits_sentinel_when_backend_dies_mid_run(monkeypatch, capsys):
     assert any(k.startswith("resnet_sweep_") for k in line["detail"]["errors"])
 
 
+def test_watchdog_fires_on_wedged_measurement():
+    """Round-3 failure the probe can't catch: the backend dies minutes
+    AFTER a successful probe and the next call blocks >60 min without
+    raising.  The watchdog thread must emit the sentinel headline and
+    hard-exit 3 (observable only from a real subprocess — os._exit)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "bench.TOTAL_BUDGET_S = 1.0\n"
+        "bench._make = lambda *a, **k: time.sleep(600)\n"
+        "bench._roofline_probe = lambda *a, **k: time.sleep(600)\n"
+        "bench.main()\n"
+    )
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.dirname(os.path.dirname(__file__)),
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 3, (p.returncode, p.stdout, p.stderr[-500:])
+    last = json.loads(p.stdout.splitlines()[-1])
+    assert last["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    assert last["unit"] == "unavailable" and last["value"] == 0.0
+    assert "watchdog" in last["detail"]["error"]
+
+
+def test_watchdog_disarmed_on_completion():
+    """A normal completion sets the event before the budget expires; the
+    armed thread must not fire afterwards (no spurious sentinel).  The
+    exit is injected so a regression can't take down the test process."""
+    import time
+    fired, exits = [], []
+    done = bench._arm_watchdog(0.2, lambda: fired.append(1),
+                               _exit=lambda code: exits.append(code))
+    done.set()
+    time.sleep(0.4)
+    assert not fired and not exits
+
+    fired2, exits2 = [], []
+    bench._arm_watchdog(0.05, lambda: fired2.append(1),
+                        _exit=lambda code: exits2.append(code))
+    time.sleep(0.3)
+    assert fired2 == [1] and exits2 == [3]
+
+
 def test_probe_skipped_when_cpu_pinned():
     """The CPU-pinned test process must never spawn an axon-init
     subprocess (conftest pins via jax.config, not JAX_PLATFORMS)."""
