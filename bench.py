@@ -8,12 +8,15 @@ latest attested full run (round 3: the round-2 on-chip record, headline
 1,681 steps/s/chip), so a ratio of ~1.0 means "held round-2 performance"
 — lineage from the round-1 host-fed 590.8 is in BASELINE.md.
 
-Workloads (BASELINE.md "must emit exactly this table's metrics"):
-  config 1  mnist_softmax            device-resident, fused steps
-  config 2  mnist_cnn_async         local-SGD emulation, device-resident
-  config 4  cifar_resnet20          augmented, + MFU estimate
-  variants  mnist_cnn pallas_ce / fused_sgd   (hand-written kernels)
+Workloads (BASELINE.md "must emit exactly this table's metrics"), in
+MEASUREMENT order — the headline is measured first (recovery windows
+between outages ran as short as ~9 min; the contract metric must land
+while the window is alive) but always EMITTED last:
   config 3  mnist_cnn_sync          HEADLINE — unroll sweep + roofline
+  config 4  cifar_resnet20          augmented, + MFU estimate
+  config 2  mnist_cnn_async         local-SGD emulation, device-resident
+  config 1  mnist_softmax           device-resident, fused steps
+  variants  mnist_cnn pallas_ce / fused_sgd   (hand-written kernels)
 
 Each line carries a ``detail`` object: every repeat (the chip sits behind
 a shared tunnel with ~20x noisy-neighbor variance, so round-over-round
@@ -380,16 +383,32 @@ def main() -> None:
             f"(budget {RETRY_BUDGET_S:.0f}s)", attempts)
         return
     errors: dict = {}
+    # The headline is measured FIRST but emitted LAST (see the workload
+    # section); between those two points the finished line lives here so
+    # a watchdog fire during a later side workload emits the REAL
+    # measured headline instead of discarding it for the sentinel.
+    held_headline: dict = {}
+
+    def fire_watchdog():
+        why = (f"watchdog: measurement phase exceeded {TOTAL_BUDGET_S:.0f}s"
+               " — a call blocked without raising (backend presumed lost "
+               "mid-run); any lines above are valid completed measurements")
+        if held_headline:
+            detail = dict(held_headline["detail"])
+            detail["errors"] = {k: v[:300] for k, v in list(errors.items())}
+            detail["watchdog"] = why
+            _emit("mnist_cnn_sync_steps_per_sec_per_chip",
+                  held_headline["per_chip"], _load_baselines(), detail)
+        else:
+            emit_unavailable(why, attempts, errors)
+
     # Armed BEFORE the in-process init: make_mesh is the next backend
     # touch and itself blocks 25-45 min if the backend died after the
     # probe succeeded.  Disarmed immediately after the headline emit.
-    # If it fires, the sentinel IS the last line (per-workload lines
-    # already printed stay valid — each was flushed as it completed).
-    watchdog_done = _arm_watchdog(TOTAL_BUDGET_S, lambda: emit_unavailable(
-        f"watchdog: measurement phase exceeded {TOTAL_BUDGET_S:.0f}s — a "
-        "call blocked without raising (backend presumed lost mid-run); "
-        "any lines above are valid completed measurements",
-        attempts, errors))
+    # If it fires, the headline (measured, or the sentinel) IS the last
+    # line (per-workload lines already printed stay valid — each was
+    # flushed as it completed).
+    watchdog_done = _arm_watchdog(TOTAL_BUDGET_S, fire_watchdog)
     try:
         mesh = make_mesh()
     except Exception as e:
@@ -487,24 +506,15 @@ def main() -> None:
     # headline sweep's deepest point.
     spe_softmax = 60000 // (100 * num_chips)
     with mesh:
-        attempt("softmax", lambda: run_simple(
-            "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
-            100, 16 * spe_softmax, 32 * spe_softmax, momentum=0.0, lr=0.5,
-            roofline_kw={"model_name": "softmax", "momentum": 0.0,
-                         "lr": 0.5, "length": 2048}))
-        attempt("resnet20", config4)
-        attempt("cnn_async", lambda: run_simple(
-            "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
-            256, 4 * spe, 8 * spe, extra_detail={"async_period": 8},
-            sync=False))
-        attempt("pallas_ce", lambda: run_simple(
-            "mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 4 * spe, 8 * spe, ce_impl="pallas"))
-        attempt("fused_sgd", lambda: run_simple(
-            "mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 4 * spe, 8 * spe, fused_opt=True))
-
         # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
+        # Measured FIRST, emitted LAST.  Round 3 measured a recovery
+        # window of ~9 minutes between two outage stretches: a run that
+        # saves the contract metric for the end captures side workloads
+        # and loses the headline when the window closes mid-run.  So the
+        # headline sweep (largest unroll first — the likely-best point is
+        # on record within the first few minutes) + its same-window
+        # roofline run before anything else, and the emit order (headline
+        # last) is preserved by holding the finished line until the end.
         # Multi-epoch fused windows (the perm ring, data/device_dataset.py)
         # let the unroll go past an epoch: sweep up to 16 epochs per call
         # (even 43 ms/call of degraded-tunnel dispatch amortizes to <3%).
@@ -512,6 +522,36 @@ def main() -> None:
             {16, spe, 4 * spe, 8 * spe, 16 * spe},
             lambda unroll: _make("mnist_cnn", "mnist", 256, unroll, mesh),
             lambda u: max(512, u * 4), "sweep_", errors)
+        headline_detail = {"repeats": best_rates, "best_unroll": best_unroll,
+                           "unroll_sweep": sweep, "batch_per_chip": 256}
+        if best_unroll is not None:
+            attach_roofline(headline_detail, best_overall, "roofline", 256)
+            # From here on a watchdog fire emits THIS measured line, not
+            # the sentinel (a wedged side workload must not discard a
+            # finished contract metric).
+            held_headline["per_chip"] = best_overall / num_chips
+            held_headline["detail"] = headline_detail
+
+        # Side workloads, most valuable first (the window may close any
+        # time): the flagship ResNet, the async contract config, then
+        # softmax and the kernel variants.
+        attempt("resnet20", config4)
+        attempt("cnn_async", lambda: run_simple(
+            "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
+            256, 4 * spe, 8 * spe, extra_detail={"async_period": 8},
+            sync=False))
+        attempt("softmax", lambda: run_simple(
+            "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
+            100, 16 * spe_softmax, 32 * spe_softmax, momentum=0.0, lr=0.5,
+            roofline_kw={"model_name": "softmax", "momentum": 0.0,
+                         "lr": 0.5, "length": 2048}))
+        attempt("pallas_ce", lambda: run_simple(
+            "mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip", "mnist_cnn",
+            "mnist", 256, 4 * spe, 8 * spe, ce_impl="pallas"))
+        attempt("fused_sgd", lambda: run_simple(
+            "mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip", "mnist_cnn",
+            "mnist", 256, 4 * spe, 8 * spe, fused_opt=True))
+
         if best_unroll is None:
             # Every headline point failed — the backend died AFTER the
             # initial probe succeeded (mid-run outage, the round-3 03:49
@@ -525,13 +565,10 @@ def main() -> None:
                 attempts, errors)
             watchdog_done.set()
             return
-        detail = {"repeats": best_rates, "best_unroll": best_unroll,
-                  "unroll_sweep": sweep, "batch_per_chip": 256}
-        attach_roofline(detail, best_overall, "roofline", 256)
-        if errors:   # attached last so a failed roofline attempt shows too
-            detail["errors"] = errors
+        if errors:   # attached last so any side-workload failure shows too
+            headline_detail["errors"] = errors
         _emit("mnist_cnn_sync_steps_per_sec_per_chip",
-              best_overall / num_chips, baselines, detail)
+              best_overall / num_chips, baselines, headline_detail)
         # Disarm right at the emit (not after mesh.__exit__): a budget
         # lapse in the gap would append a sentinel AFTER a valid headline.
         watchdog_done.set()

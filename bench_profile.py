@@ -64,8 +64,45 @@ def main() -> None:
                          "minutes on the virtual CPU mesh)")
     args = ap.parse_args()
 
+    probe_attempts: list = []
+
+    def emit_unavailable(why: str) -> None:
+        print(json.dumps({
+            "metric": "resnet20_attribution", "value": 0.0,
+            "unit": "unavailable", "vs_baseline": 0.0,
+            "detail": {"error": why[:500],
+                       "probe_attempts": probe_attempts[-8:]}}), flush=True)
+
+    # Same outage resilience as bench.main: probe-with-retries before the
+    # in-process init, the init itself sentinel-guarded, and a watchdog
+    # for calls that block without raising after the backend dies mid-run
+    # (round-3 failure shape).
+    reachable, attempts = bench._wait_for_backend()
+    probe_attempts.extend(attempts)
+    if not reachable:
+        emit_unavailable("TPU backend unreachable after probe retries "
+                         f"(budget {bench.RETRY_BUDGET_S:.0f}s)")
+        return
+    if bench._cpu_pinned():
+        # CPU runs (CI / virtual mesh) are legitimately slow — the
+        # --roofline_length help text warns default sizes take tens of
+        # minutes there — and can't wedge on a tunnel; don't arm.
+        watchdog_done = None
+    else:
+        watchdog_done = bench._arm_watchdog(
+            bench.TOTAL_BUDGET_S, lambda: emit_unavailable(
+                f"watchdog: profiling exceeded {bench.TOTAL_BUDGET_S:.0f}s "
+                "— a call blocked without raising (backend presumed lost "
+                "mid-run); lines above are valid completed measurements"))
+
     from distributedtensorflowexample_tpu.parallel import make_mesh
-    mesh = make_mesh()
+    try:
+        mesh = make_mesh()
+    except Exception as e:
+        emit_unavailable(f"TPU backend unavailable: {e!r}")
+        if watchdog_done is not None:
+            watchdog_done.set()
+        return
     n = mesh.size
     rates = {}
     errors = {}
@@ -175,6 +212,8 @@ def main() -> None:
                       else 0.0),
             "unit": "measured/roofline", "vs_baseline": 1.0,
             "detail": detail}), flush=True)
+    if watchdog_done is not None:
+        watchdog_done.set()
 
 
 if __name__ == "__main__":

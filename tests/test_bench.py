@@ -256,6 +256,38 @@ def test_watchdog_fires_on_wedged_measurement():
     assert "watchdog" in last["detail"]["error"]
 
 
+def test_watchdog_emits_held_headline_when_side_workload_wedges():
+    """The headline is measured first and held; if a LATER side workload
+    wedges, the watchdog must emit the real measured headline (tagged
+    with detail.watchdog), never discard it for the 0.0 sentinel."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "bench.TOTAL_BUDGET_S = 8.0\n"   # > make_mesh+fakes, << sleep(600)
+        "bench._sweep = lambda *a, **k: (100.0, 16, [100.0],"
+        " {'16': [100.0]})\n"
+        "bench._roofline_probe = lambda *a, **k: [200.0]\n"
+        "bench._make = lambda *a, **k: time.sleep(600)\n"
+        "bench.main()\n"
+    )
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.dirname(os.path.dirname(__file__)),
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 3, (p.returncode, p.stdout, p.stderr[-500:])
+    last = json.loads(p.stdout.splitlines()[-1])
+    assert last["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    assert last["unit"] == "steps/sec/chip" and last["value"] == 100.0
+    assert "watchdog" in last["detail"]
+    assert last["detail"]["vs_roofline"] == 0.5
+
+
 def test_watchdog_disarmed_on_completion():
     """A normal completion sets the event before the budget expires; the
     armed thread must not fire afterwards (no spurious sentinel).  The
