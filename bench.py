@@ -511,26 +511,55 @@ def main() -> None:
         # window of ~9 minutes between two outage stretches: a run that
         # saves the contract metric for the end captures side workloads
         # and loses the headline when the window closes mid-run.  So the
-        # headline sweep (largest unroll first — the likely-best point is
-        # on record within the first few minutes) + its same-window
-        # roofline run before anything else, and the emit order (headline
-        # last) is preserved by holding the finished line until the end.
+        # likely-best sweep point (deepest unroll — it won every recorded
+        # sweep) runs first, its same-window roofline immediately after
+        # (the vs_roofline ratio is the one number that survives chip-
+        # sharing variance — it must come from the SAME window as the
+        # measurement it calibrates), then the remaining sweep points;
+        # the emit order (headline last) is preserved by holding the
+        # finished line until the end.
         # Multi-epoch fused windows (the perm ring, data/device_dataset.py)
         # let the unroll go past an epoch: sweep up to 16 epochs per call
         # (even 43 ms/call of degraded-tunnel dispatch amortizes to <3%).
+        mk_headline = lambda unroll: _make("mnist_cnn", "mnist", 256,
+                                           unroll, mesh)
+        steps_for = lambda u: max(512, u * 4)
         best_overall, best_unroll, best_rates, sweep = _sweep(
-            {16, spe, 4 * spe, 8 * spe, 16 * spe},
-            lambda unroll: _make("mnist_cnn", "mnist", 256, unroll, mesh),
-            lambda u: max(512, u * 4), "sweep_", errors)
+            {16 * spe}, mk_headline, steps_for, "sweep_", errors)
         headline_detail = {"repeats": best_rates, "best_unroll": best_unroll,
                            "unroll_sweep": sweep, "batch_per_chip": 256}
-        if best_unroll is not None:
-            attach_roofline(headline_detail, best_overall, "roofline", 256)
-            # From here on a watchdog fire emits THIS measured line, not
-            # the sentinel (a wedged side workload must not discard a
-            # finished contract metric).
-            held_headline["per_chip"] = best_overall / num_chips
+
+        def hold_best(b, u, r):
+            """Record (b, u, r) as the held headline.  From the first
+            call on, a watchdog fire emits THIS measured line, not the
+            sentinel (a wedged side workload must not discard a finished
+            contract metric).  The roofline is RE-probed on every call:
+            the ratio only means something when probe and measurement
+            share a window, so a promoted later point must not inherit
+            the first point's probe — and the stale keys are dropped
+            first so a failed re-probe can't leave a cross-window ratio
+            behind."""
+            nonlocal best_overall, best_unroll, best_rates
+            best_overall, best_unroll, best_rates = b, u, r
+            headline_detail["repeats"] = r
+            headline_detail["best_unroll"] = u
+            headline_detail.pop("roofline_probe", None)
+            headline_detail.pop("vs_roofline", None)
+            attach_roofline(headline_detail, b, "roofline", 256)
+            held_headline["per_chip"] = b / num_chips
             held_headline["detail"] = headline_detail
+
+        if best_unroll is not None:
+            hold_best(best_overall, best_unroll, best_rates)
+
+        # Remaining sweep points (still before the side workloads); a
+        # later point that beats — or replaces a failed — first point is
+        # promoted into the held line.
+        b2, u2, r2, s2 = _sweep({16, spe, 4 * spe, 8 * spe}, mk_headline,
+                                steps_for, "sweep_", errors)
+        sweep.update(s2)   # same dict as headline_detail["unroll_sweep"]
+        if u2 is not None and b2 > best_overall:
+            hold_best(b2, u2, r2)
 
         # Side workloads, most valuable first (the window may close any
         # time): the flagship ResNet, the async contract config, then
