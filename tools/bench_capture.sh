@@ -1,0 +1,52 @@
+#!/bin/bash
+# One full on-chip capture: bench.py (headline measured first,
+# watchdogged - see docs/DESIGN.md §10), then bench_profile.py (ResNet
+# attribution + jax.profiler trace), then the trace tarred into the repo
+# if it is small enough to commit.  Launched by tools/tpu_watch.sh on
+# backend recovery, or by hand:  setsid nohup tools/bench_capture.sh &
+#
+# Detached on purpose: a tool-timeout SIGKILL on a chip-holding process
+# wedges the shared tunnel (verify skill), so captures must never run
+# under a harness timeout.
+
+cd "$(dirname "$0")/.." || exit 1
+OUT=${OUT:-BENCH_auto_r03.json}
+PROFILE_OUT=${PROFILE_OUT:-PROFILE_r03.json}
+TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r03.tgz}
+LOG=${LOG:-/tmp/bench_capture.log}
+
+date -u >> "$LOG"
+python bench.py > "$OUT.tmp" 2>> "$LOG"
+rc=$?
+# Keep whatever landed even on failure: each line is flushed as it
+# completes, so a partial file is a valid partial capture.
+if [ -s "$OUT.tmp" ]; then mv "$OUT.tmp" "$OUT"; else rm -f "$OUT.tmp"; fi
+echo "bench rc=$rc" >> "$LOG"
+
+if [ "$rc" -eq 3 ]; then
+  # bench's watchdog fired: the backend is provably wedged.  Running the
+  # profile against it would burn another BENCH_TOTAL_BUDGET_S while
+  # this live process suppresses nothing useful — stop here; the next
+  # recovery window relaunches the whole capture.
+  echo "profile skipped: bench watchdog fired (backend wedged)" >> "$LOG"
+else
+  python bench_profile.py > "$PROFILE_OUT.tmp" 2>> "$LOG"
+  rc2=$?
+  if [ -s "$PROFILE_OUT.tmp" ]; then
+    mv "$PROFILE_OUT.tmp" "$PROFILE_OUT"
+  else
+    rm -f "$PROFILE_OUT.tmp"
+  fi
+  echo "profile rc=$rc2" >> "$LOG"
+fi
+
+if [ -d /tmp/resnet_trace ]; then
+  sz=$(du -sm /tmp/resnet_trace | cut -f1)
+  if [ "$sz" -le 25 ]; then
+    tar czf "$TRACE_TGZ" -C /tmp resnet_trace
+    echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
+  else
+    echo "trace too big to commit (${sz}MB), left in /tmp/resnet_trace" >> "$LOG"
+  fi
+fi
+date -u >> "$LOG"
