@@ -41,6 +41,21 @@ import jax.numpy as jnp
 REPEATS = 3
 PEAK_FLOPS = float(os.environ.get("TPU_PEAK_FLOPS", 197e12))  # v5e bf16
 
+# Workload sizing — module-level so the end-to-end smoke test
+# (tests/test_bench_e2e.py) can shrink the SAME main() code path the
+# driver runs, instead of faking pieces of it.  The driver's run uses
+# these defaults unchanged.
+DATA_DIR = "/tmp/data"
+TRAIN_N = {"mnist": 60000, "cifar10": 50000}     # split sizes for sizing
+BATCH = {"cnn": 256, "softmax": 100, "resnet": 256}   # per chip
+MIN_STEPS = {"headline": 512, "resnet": 96}      # per measurement
+ROOFLINE_LEN = {"headline": 256, "softmax": 2048, "resnet": 128}
+# Sweep shapes as functions of steps-per-epoch.  Module-level for the
+# same reason: each distinct unroll is a fresh XLA compile, and compile
+# count (not step count) dominates the smoke test's cold runtime.
+HEADLINE_REST_UNROLLS = lambda spe: {16, spe, 4 * spe, 8 * spe}
+RESNET_UNROLLS = lambda spe: {8, 64, spe}
+
 # Outage resilience (round-2 postmortem: a failed in-process backend init
 # blocks 25-45 min and the driver runs bench exactly once per round, so a
 # single outage window zeroed the round's official record).  Before paying
@@ -233,7 +248,7 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
           mesh, *, momentum: float = 0.9, ce_impl: str = "xla",
           fused_opt: bool = False, augment: str = "none", lr: float = 0.05,
           sync: bool = True, async_period: int = 8,
-          data_dir: str = "/tmp/data"):
+          data_dir: str | None = None):
     import optax
 
     from distributedtensorflowexample_tpu.data import DeviceDataset
@@ -251,7 +266,9 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
     global_batch = batch_per_chip * num_chips
     load = load_mnist if dataset == "mnist" else load_cifar10
     sample = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
-    train_x, train_y = load(data_dir, "train")
+    # Resolved at call time (not def time) so tests can repoint DATA_DIR.
+    train_x, train_y = load(data_dir if data_dir is not None else DATA_DIR,
+                            "train")
     ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh, seed=0,
                        steps_per_next=unroll)
 
@@ -457,11 +474,12 @@ def main() -> None:
         # tunnel; at unroll 8 that dispatch alone caps ResNet at ~186
         # steps/s, so the number said nothing about compute.  Sweep up to
         # a full epoch per call (spe = 195 at batch 256).
-        spe_cifar = 50000 // (256 * num_chips)
+        b_rn = BATCH["resnet"]
+        spe_cifar = TRAIN_N["cifar10"] // (b_rn * num_chips)
         flops_box: list = []   # at-most-once cost probe across sweep points
 
         def mk(unroll):
-            step, ds, state, u = _make("resnet20", "cifar10", 256, unroll,
+            step, ds, state, u = _make("resnet20", "cifar10", b_rn, unroll,
                                        mesh, augment="cifar", lr=0.1)
             if not flops_box:
                 # peek, not next: the probe must not advance the ring ahead
@@ -471,7 +489,8 @@ def main() -> None:
             return step, ds, state, u
 
         best_overall, best_unroll, best_rates, sweep = _sweep(
-            {8, 64, spe_cifar}, mk, lambda u: max(96, 2 * u),
+            RESNET_UNROLLS(spe_cifar), mk,
+            lambda u: max(MIN_STEPS["resnet"], 2 * u),
             "resnet_sweep_", errors)
         if best_unroll is None:
             # Every point failed: emit nothing (a 0.0 line would read as a
@@ -486,11 +505,11 @@ def main() -> None:
         # augment/gather): the measured/roofline gap is the input+augment+
         # dispatch share — the attribution the MFU number alone can't give.
         detail = {"repeats": best_rates, "best_unroll": best_unroll,
-                  "unroll_sweep": sweep, "batch_per_chip": 256,
+                  "unroll_sweep": sweep, "batch_per_chip": b_rn,
                   "flops_per_step": flops,
                   "mfu": round(mfu, 4) if mfu is not None else None}
-        attach_roofline(detail, best_overall, "roofline_resnet", 256,
-                        length=128, model_name="resnet20",
+        attach_roofline(detail, best_overall, "roofline_resnet", b_rn,
+                        length=ROOFLINE_LEN["resnet"], model_name="resnet20",
                         sample=(32, 32, 3), lr=0.1)
         _emit("cifar_resnet20_steps_per_sec_per_chip", per_chip, baselines,
               detail)
@@ -500,11 +519,12 @@ def main() -> None:
     # steps so they need the deepest fusion; the kernel variants use the
     # same unroll as the headline sweep's 4-epoch point so their deltas
     # read directly against sweep["936"] (single-chip).
-    spe = 60000 // (256 * num_chips)
+    b_cnn, b_sm = BATCH["cnn"], BATCH["softmax"]
+    spe = TRAIN_N["mnist"] // (b_cnn * num_chips)
     # Softmax steps are ~10x shorter than CNN steps, so dispatch still
     # shows at unroll 2048 (~3.4 epochs); fuse 16 epochs per call like the
     # headline sweep's deepest point.
-    spe_softmax = 60000 // (100 * num_chips)
+    spe_softmax = TRAIN_N["mnist"] // (b_sm * num_chips)
     with mesh:
         # --- config 3 HEADLINE: MNIST CNN sync, unroll sweep -------------
         # Measured FIRST, emitted LAST.  Round 3 measured a recovery
@@ -521,13 +541,13 @@ def main() -> None:
         # Multi-epoch fused windows (the perm ring, data/device_dataset.py)
         # let the unroll go past an epoch: sweep up to 16 epochs per call
         # (even 43 ms/call of degraded-tunnel dispatch amortizes to <3%).
-        mk_headline = lambda unroll: _make("mnist_cnn", "mnist", 256,
+        mk_headline = lambda unroll: _make("mnist_cnn", "mnist", b_cnn,
                                            unroll, mesh)
-        steps_for = lambda u: max(512, u * 4)
+        steps_for = lambda u: max(MIN_STEPS["headline"], u * 4)
         best_overall, best_unroll, best_rates, sweep = _sweep(
             {16 * spe}, mk_headline, steps_for, "sweep_", errors)
         headline_detail = {"repeats": best_rates, "best_unroll": best_unroll,
-                           "unroll_sweep": sweep, "batch_per_chip": 256}
+                           "unroll_sweep": sweep, "batch_per_chip": b_cnn}
 
         def hold_best(b, u, r):
             """Record (b, u, r) as the held headline.  From the first
@@ -545,7 +565,8 @@ def main() -> None:
             headline_detail["best_unroll"] = u
             headline_detail.pop("roofline_probe", None)
             headline_detail.pop("vs_roofline", None)
-            attach_roofline(headline_detail, b, "roofline", 256)
+            attach_roofline(headline_detail, b, "roofline", b_cnn,
+                            length=ROOFLINE_LEN["headline"])
             held_headline["per_chip"] = b / num_chips
             held_headline["detail"] = headline_detail
 
@@ -555,7 +576,7 @@ def main() -> None:
         # Remaining sweep points (still before the side workloads); a
         # later point that beats — or replaces a failed — first point is
         # promoted into the held line.
-        b2, u2, r2, s2 = _sweep({16, spe, 4 * spe, 8 * spe}, mk_headline,
+        b2, u2, r2, s2 = _sweep(HEADLINE_REST_UNROLLS(spe), mk_headline,
                                 steps_for, "sweep_", errors)
         sweep.update(s2)   # same dict as headline_detail["unroll_sweep"]
         if u2 is not None and b2 > best_overall:
@@ -567,19 +588,19 @@ def main() -> None:
         attempt("resnet20", config4)
         attempt("cnn_async", lambda: run_simple(
             "mnist_cnn_async_steps_per_sec_per_chip", "mnist_cnn", "mnist",
-            256, 4 * spe, 8 * spe, extra_detail={"async_period": 8},
+            b_cnn, 4 * spe, 8 * spe, extra_detail={"async_period": 8},
             sync=False))
         attempt("softmax", lambda: run_simple(
             "mnist_softmax_steps_per_sec_per_chip", "softmax", "mnist",
-            100, 16 * spe_softmax, 32 * spe_softmax, momentum=0.0, lr=0.5,
+            b_sm, 16 * spe_softmax, 32 * spe_softmax, momentum=0.0, lr=0.5,
             roofline_kw={"model_name": "softmax", "momentum": 0.0,
-                         "lr": 0.5, "length": 2048}))
+                         "lr": 0.5, "length": ROOFLINE_LEN["softmax"]}))
         attempt("pallas_ce", lambda: run_simple(
             "mnist_cnn_sync_pallas_ce_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 4 * spe, 8 * spe, ce_impl="pallas"))
+            "mnist", b_cnn, 4 * spe, 8 * spe, ce_impl="pallas"))
         attempt("fused_sgd", lambda: run_simple(
             "mnist_cnn_sync_fused_sgd_steps_per_sec_per_chip", "mnist_cnn",
-            "mnist", 256, 4 * spe, 8 * spe, fused_opt=True))
+            "mnist", b_cnn, 4 * spe, 8 * spe, fused_opt=True))
 
         if best_unroll is None:
             # Every headline point failed — the backend died AFTER the

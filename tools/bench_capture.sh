@@ -30,6 +30,9 @@ if [ "$rc" -eq 3 ]; then
   # recovery window relaunches the whole capture.
   echo "profile skipped: bench watchdog fired (backend wedged)" >> "$LOG"
 else
+  # A stale trace from an earlier run must not get tarred as THIS
+  # window's artifact.
+  rm -rf /tmp/resnet_trace
   python bench_profile.py > "$PROFILE_OUT.tmp" 2>> "$LOG"
   rc2=$?
   if [ -s "$PROFILE_OUT.tmp" ]; then
@@ -38,15 +41,14 @@ else
     rm -f "$PROFILE_OUT.tmp"
   fi
   echo "profile rc=$rc2" >> "$LOG"
-fi
-
-if [ -d /tmp/resnet_trace ]; then
-  sz=$(du -sm /tmp/resnet_trace | cut -f1)
-  if [ "$sz" -le 25 ]; then
-    tar czf "$TRACE_TGZ" -C /tmp resnet_trace
-    echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
-  else
-    echo "trace too big to commit (${sz}MB), left in /tmp/resnet_trace" >> "$LOG"
+  if [ "$rc2" -eq 0 ] && [ -d /tmp/resnet_trace ]; then
+    sz=$(du -sm /tmp/resnet_trace | cut -f1)
+    if [ "$sz" -le 25 ]; then
+      tar czf "$TRACE_TGZ" -C /tmp resnet_trace
+      echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
+    else
+      echo "trace too big to commit (${sz}MB), left in /tmp/resnet_trace" >> "$LOG"
+    fi
   fi
 fi
 date -u >> "$LOG"

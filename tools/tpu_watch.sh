@@ -8,12 +8,14 @@
 # capture (tools/bench_capture.sh); a backend that stays up does not
 # re-launch, and each new window after an outage gets its own capture.
 #
-# On the edge, anything still running from a previous window — a parked
-# bench or a wedged capture — is killed first: its tunnel connection
-# died with the outage (no healthy chip lease to wedge; SIGTERM is the
-# OS-default immediate termination for python), and a short window
-# (round 3 measured one at ~9 minutes) must go to the current
-# headline-first bench, not a leftover process's stale order.
+# On the edge, bench processes OLDER than the window (age > 15 min) are
+# killed first: their tunnel connections died with the outage (no
+# healthy chip lease to wedge; SIGTERM is the OS-default immediate
+# termination for python), and a short window (round 3 measured one at
+# ~9 minutes) must go to the current headline-first bench, not a parked
+# process's stale order.  A YOUNG bench — one whose own probe-retry
+# loop re-acquired the recovered backend — is healthy and left alone
+# (no new launch either: it IS the capture).
 #
 # `prev` starts OK so a watcher (re)started next to a HEALTHY running
 # capture never kills it; in an already-healthy window with no capture,
@@ -47,14 +49,33 @@ print('OK' if ok else 'FAIL', info)
     OK*)
       touch "$RECOVERED_MARKER"
       if [ "$prev" != OK ]; then
-        echo "$ts FAIL->OK edge: clearing stale processes" >> "$WATCH_LOG"
-        pkill -TERM -f "bench_capture" 2>/dev/null
-        pkill -TERM -f "python bench" 2>/dev/null
-        sleep 10
-        pkill -KILL -f "python bench" 2>/dev/null
-        sleep 20
-        echo "$ts launching auto-capture" >> "$WATCH_LOG"
-        setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+        # Only processes OLDER than this recovery window are stale: a
+        # young bench (its own probe-retry loop re-acquired the backend
+        # just before our probe did) is HEALTHY and holds a live chip
+        # lease — killing it mid-init is the documented tunnel-wedging
+        # action.  Age gate: anything older than 15 min predates the
+        # window (outages run hours; windows are minutes old by now).
+        young=0
+        for pid in $(pgrep -f "python bench"); do
+          age=$(ps -o etimes= -p "$pid" | tr -d ' ')
+          if [ -n "$age" ] && [ "$age" -gt 900 ]; then
+            echo "$ts killing stale bench pid $pid (age ${age}s)" >> "$WATCH_LOG"
+            kill -TERM "$pid" 2>/dev/null
+            sleep 10
+            kill -KILL "$pid" 2>/dev/null
+          else
+            young=1
+          fi
+        done
+        if [ "$young" -eq 1 ]; then
+          echo "$ts young bench already capturing; not launching" >> "$WATCH_LOG"
+        elif pgrep -f "bash tools/bench_capture.sh" > /dev/null; then
+          echo "$ts capture script already live; not launching" >> "$WATCH_LOG"
+        else
+          sleep 10
+          echo "$ts launching auto-capture" >> "$WATCH_LOG"
+          setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+        fi
       fi
       prev=OK
       ;;
