@@ -1,0 +1,119 @@
+"""tools/tpu_watch.sh recovery-edge logic, tested with PATH shims.
+
+The FAIL->OK edge branch (kill stale bench, guard against live
+captures, launch exactly one capture per window) has never executed
+against a real recovery — the backend was down whenever the watcher
+ran — and a bug there silently loses a recovery window.  These tests
+drive the real script with a shimmed `python` (probe fails once, then
+OK — `prev` starts OK by design, so the edge needs a FAIL first),
+`pgrep` (reports a fake stale bench and/or a live capture), `ps`
+(controls the fake bench's age) and `setsid` (records the launch
+instead of executing it), so no real process is probed, killed, or
+spawned.
+"""
+
+import os
+import signal
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Above the kernel's architectural pid ceiling (pid_max caps at
+# 4194304), so the script's un-shimmed builtin `kill` on it can never
+# hit a real process; assertions read the log line instead.
+FAKE_PID = 4999999
+
+
+def _write_shim(bindir, name, body):
+    path = os.path.join(bindir, name)
+    with open(path, "w") as f:
+        f.write("#!/bin/bash\n" + body + "\n")
+    os.chmod(path, 0o755)
+
+
+def _run_watcher(tmp_path, *, bench_age_s=None, capture_live=False,
+                 done_when, timeout_s=60, settle_s=0.0):
+    """Start the real tools/tpu_watch.sh under shims and stop it once
+    ``done_when(log_text)`` is true (or on timeout).  ``bench_age_s``
+    not None makes the pgrep shim report FAKE_PID as a parked bench of
+    that age; ``capture_live`` makes it report a live capture script.
+    Returns (log_text, launches_path, marker_path)."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    launches = tmp_path / "launches.log"
+    watch_log = tmp_path / "watch.log"
+    marker = tmp_path / "recovered"
+
+    state = tmp_path / "probe_state"
+    _write_shim(str(bindir), "python",
+                'if [ ! -f %s ]; then touch %s; echo "FAIL shim"; '
+                'else echo "OK shim-probe"; fi' % (state, state))
+    bench_case = ('*"python bench"*) echo %d;;' % FAKE_PID
+                  if bench_age_s is not None else '')
+    capture_case = ('*bench_capture*) echo %d;;' % FAKE_PID
+                    if capture_live else '')
+    _write_shim(str(bindir), "pgrep",
+                'case "$*" in %s %s *) exit 1;; esac'
+                % (bench_case, capture_case))
+    _write_shim(str(bindir), "ps", 'echo " %d"' % (bench_age_s or 0))
+    _write_shim(str(bindir), "setsid", 'echo "$@" >> %s' % launches)
+
+    env = dict(os.environ,
+               PATH=f"{bindir}:{os.environ['PATH']}",
+               WATCH_LOG=str(watch_log),
+               RECOVERED_MARKER=str(marker),
+               PROBE_INTERVAL_S="1")
+    proc = subprocess.Popen(["bash", os.path.join(REPO, "tools",
+                                                  "tpu_watch.sh")],
+                            env=env, cwd=REPO,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            log = watch_log.read_text() if watch_log.exists() else ""
+            if done_when(log):
+                # Let a few more probe cycles run so once-per-edge
+                # assertions observe the steady state, not the instant
+                # of the first firing.
+                time.sleep(settle_s)
+                break
+            time.sleep(0.5)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+    log = watch_log.read_text() if watch_log.exists() else ""
+    return log, launches, marker
+
+
+def test_recovery_edge_kills_stale_bench_and_launches_once(tmp_path):
+    log, launches, marker = _run_watcher(
+        tmp_path, bench_age_s=1000,   # past the 900 s stale gate
+        done_when=lambda log: "launching auto-capture" in log,
+        settle_s=3.0)                 # a few more OK probes: edge, not level
+    assert f"killing stale bench pid {FAKE_PID}" in log
+    assert "launching auto-capture" in log, log
+    assert marker.exists()
+    lines = launches.read_text().strip().splitlines()
+    assert len(lines) == 1, lines
+    assert "bench_capture.sh" in lines[0]
+    assert log.count("launching auto-capture") == 1
+
+
+def test_young_bench_is_left_alone(tmp_path):
+    log, launches, _ = _run_watcher(
+        tmp_path, bench_age_s=60,     # re-acquired the backend itself
+        done_when=lambda log: "young bench" in log)
+    assert "young bench already capturing; not launching" in log
+    assert "killing stale bench" not in log
+    assert not launches.exists()
+
+
+def test_live_capture_script_suppresses_launch(tmp_path):
+    log, launches, _ = _run_watcher(
+        tmp_path, capture_live=True,
+        done_when=lambda log: "already live" in log)
+    assert "capture script already live; not launching" in log
+    assert not launches.exists()
