@@ -83,10 +83,13 @@ def main() -> None:
         emit_unavailable("TPU backend unreachable after probe retries "
                          f"(budget {bench.RETRY_BUDGET_S:.0f}s)")
         return
-    if bench._cpu_pinned():
-        # CPU runs (CI / virtual mesh) are legitimately slow — the
-        # --roofline_length help text warns default sizes take tens of
-        # minutes there — and can't wedge on a tunnel; don't arm.
+    if bench._cpu_platform():
+        # CPU-platform runs (CI / virtual mesh) are legitimately slow —
+        # the --roofline_length help text warns default sizes take tens
+        # of minutes there — and can't wedge on a tunnel; don't arm.
+        # Platform check only (NOT _cpu_pinned): a real TPU run with
+        # BENCH_SKIP_PROBE=1 can still wedge mid-profile and, in the
+        # detached capture path, would hang forever unwatched.
         watchdog_done = None
     else:
         watchdog_done = bench._arm_watchdog(

@@ -78,6 +78,11 @@ def test_bench_main_success_path(small_synthetic, monkeypatch, capsys,
     bench.main()
 
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    # Line 0 is the always-first provisional sentinel (VERDICT r3 #1a);
+    # on the success path it must be the ONLY unavailable-unit line.
+    assert lines[0]["detail"].get("provisional") is True
+    assert sum(l["unit"] == "unavailable" for l in lines) == 1
+    lines = lines[1:]
     metrics = [l["metric"] for l in lines]
     assert set(metrics) == ALL_METRICS and len(metrics) == len(ALL_METRICS)
     # Headline LAST — the output contract the driver parses.
@@ -107,3 +112,9 @@ def test_bench_main_success_path(small_synthetic, monkeypatch, capsys,
     softmax = next(l for l in lines
                    if l["metric"] == "mnist_softmax_steps_per_sec_per_chip")
     assert softmax["detail"]["vs_roofline"] > 0
+    # Same-window cost decomposition (VERDICT r3 #5): the measured step
+    # and roofline step both carry flops/bytes, and the bytes ratio that
+    # attributes the vs_roofline gap is derived from them.
+    assert softmax["detail"]["cost_per_step"]["bytes_accessed"] > 0
+    assert softmax["detail"]["roofline_cost_per_step"]["bytes_accessed"] > 0
+    assert softmax["detail"]["roofline_bytes_ratio"] > 0

