@@ -23,7 +23,8 @@ PAD = 4
 
 
 def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
-    """[B, H, W, C] float32 → same shape, randomly cropped + flipped."""
+    """[B, H, W, C] any dtype → same shape, randomly cropped + flipped
+    (pure pixel rearrangement: runs on uint8-resident batches too)."""
     b, h, w, c = images.shape
     ky, kx, kf = jax.random.split(key, 3)
     ys = jax.random.randint(ky, (b,), 0, 2 * PAD + 1)

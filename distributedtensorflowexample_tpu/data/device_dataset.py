@@ -44,6 +44,90 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def make_dequant_lut(spec: str) -> np.ndarray:
+    """The 256 float32 values a uint8 pixel can dequantize to, computed
+    on the HOST with the loader's own numpy ops (mnist.py / cifar10.py:
+    ``raw/255.0`` then optionally ``(x - MEAN) / STD``) so the lookup is
+    BITWISE-exact — recomputing the arithmetic in XLA is NOT safe (XLA
+    strength-reduces the division by 255 to a reciprocal multiply, ~1
+    ulp off on ~40% of values, measured).  Shape [256] ("unit") or
+    [256, C] (per-channel normalization)."""
+    if spec == "unit":
+        return np.arange(256, dtype=np.float32) / 255.0
+    if spec == "cifar":
+        from distributedtensorflowexample_tpu.data.cifar10 import (
+            CIFAR10_MEAN, CIFAR10_STD)
+        base = np.arange(256, dtype=np.float32)[:, None] / 255.0
+        return ((base - CIFAR10_MEAN) / CIFAR10_STD).astype(np.float32)
+    raise ValueError(f"unknown dequant spec {spec!r}")
+
+
+def apply_dequant_lut(u8: jnp.ndarray, lut: jnp.ndarray) -> jnp.ndarray:
+    """uint8 pixels -> float32 through a [256] / [256, C] LUT.  The table
+    lives in VMEM and the lookup fuses into the step, so the win of
+    uint8-resident storage (4x less HBM gather traffic) is free."""
+    idx = u8.astype(jnp.int32)
+    if lut.ndim == 1:
+        return lut[idx]
+    return lut[idx, jnp.arange(lut.shape[1])]
+
+
+def dequantize_images(u8: jnp.ndarray, spec: str) -> jnp.ndarray:
+    """uint8 pixels -> the float32 values the loader would have produced
+    (see make_dequant_lut for the bitwise-exactness argument)."""
+    return apply_dequant_lut(u8, jnp.asarray(make_dequant_lut(spec)))
+
+
+def _dequant_numpy(u8: np.ndarray, spec: str) -> np.ndarray:
+    """Host-side reference of dequantize_images (verification path)."""
+    x = u8.astype(np.float32) / 255.0
+    if spec == "cifar":
+        from distributedtensorflowexample_tpu.data.cifar10 import (
+            CIFAR10_MEAN, CIFAR10_STD)
+        x = (x - CIFAR10_MEAN) / CIFAR10_STD
+    return x
+
+
+def _try_quantize(x: np.ndarray, chunk: int = 4096):
+    """(uint8 split, dequant spec) if ``x`` is EXACTLY representable as
+    dequantize_images(u8, spec) for one of the known pipelines (raw
+    [0,1] "unit" pixels, or CIFAR mean/std-normalized); else None.
+
+    Exactness is verified bitwise chunk-by-chunk (bounded memory), so a
+    caller can never lose precision silently: anything not byte-exact —
+    arbitrary float inputs, a future normalization this doesn't know —
+    stays float32-resident."""
+    if x.dtype != np.float32 or x.ndim < 2:
+        return None
+    lo, hi = float(x.min()), float(x.max())
+    candidates = []
+    if 0.0 <= lo and hi <= 1.0:
+        candidates.append(("unit",
+                           lambda c: np.rint(c * 255.0)))
+    if x.shape[-1] == 3:
+        from distributedtensorflowexample_tpu.data.cifar10 import (
+            CIFAR10_MEAN, CIFAR10_STD)
+        candidates.append(("cifar", lambda c: np.rint(
+            (c.astype(np.float64) * CIFAR10_STD + CIFAR10_MEAN) * 255.0)))
+    for spec, recover in candidates:
+        out = np.empty(x.shape, np.uint8)
+        ok = True
+        for i in range(0, len(x), chunk):
+            c = x[i:i + chunk]
+            u = recover(c)
+            if u.min() < 0 or u.max() > 255:
+                ok = False
+                break
+            u = u.astype(np.uint8)
+            if not np.array_equal(_dequant_numpy(u, spec), c):
+                ok = False
+                break
+            out[i:i + chunk] = u
+        if ok:
+            return out, spec
+    return None
+
+
 class DeviceDataset:
     """Iterator yielding ``{"images", "labels", "perm"}`` device pytrees.
 
@@ -70,11 +154,33 @@ class DeviceDataset:
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
                  shuffle: bool = True, start_step: int = 0,
-                 steps_per_next: int = 1):
+                 steps_per_next: int = 1, quantize: str = "auto"):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
         the train step's ``unroll_steps`` so the perm ring is refreshed on
         the right call.  Any value >= 1 works; the ring is sized to hold
-        every epoch one window can touch plus a prefetch slot."""
+        every epoch one window can touch plus a prefetch slot.
+
+        ``quantize="auto"`` (default) stores the split as uint8 in HBM
+        when the float32 pixels are BITWISE-recoverable from one of the
+        known 8-bit pipelines (verified element-exact at build time;
+        see ``_try_quantize``): the per-step on-device gather then moves
+        4x fewer bytes.  The dequant LUT travels INSIDE the yielded data
+        pytree (``data["lut"]``) and the device gather dtype-dispatches
+        on the resident images, so no call site can forget to dequantize
+        — the float32 batches the step sees are bitwise identical either
+        way.  ``"off"`` forces float storage for float input
+        (``self.dequant`` is None); raw uint8 input always dequantizes
+        as u/255 ("unit")."""
+        if quantize not in ("auto", "off"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        self.dequant: str | None = None
+        if images.dtype == np.uint8:
+            # Raw bytes: downstream floats are u/255 by convention.
+            self.dequant = "unit"
+        elif quantize == "auto":
+            q = _try_quantize(np.asarray(images))
+            if q is not None:
+                images, self.dequant = q
         if len(images) < batch_size:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than "
@@ -103,6 +209,8 @@ class DeviceDataset:
             repl, put = None, jax.device_put
         self.images = put(np.ascontiguousarray(images))
         self.labels = put(np.ascontiguousarray(labels))
+        self._lut = (put(make_dequant_lut(self.dequant))
+                     if self.dequant is not None else None)
 
         base = jax.random.PRNGKey(seed)
 
@@ -148,8 +256,11 @@ class DeviceDataset:
         # after it).
         for epoch in range(first, last + 2):
             self._ensure_epoch(epoch)
-        return {"images": self.images, "labels": self.labels,
+        data = {"images": self.images, "labels": self.labels,
                 "perm": self._ring}
+        if self._lut is not None:
+            data["lut"] = self._lut
+        return data
 
     def __next__(self):
         data = self.peek()

@@ -80,7 +80,13 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
     """(step, rng, data) -> batch: the on-device minibatch gather from a
     resident split (see ``data.DeviceDataset``), shared by the sync and
     async indexed step builders.  ``num_slots`` must equal the dataset's
-    perm-ring size (``ds.num_slots``)."""
+    perm-ring size (``ds.num_slots``).
+
+    A uint8-resident split (4x less gather traffic) dequantizes to the
+    loader's exact float32 values on the gathered batch only: the LUT
+    rides in ``data["lut"]`` and the dispatch is on the resident dtype
+    (static at trace time), so quantization needs NO step-factory
+    plumbing and no call site can silently train on raw bytes."""
     if augment not in ("none", "cifar"):
         raise ValueError(f"unknown augment {augment!r}")
 
@@ -92,16 +98,25 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
         pos = (step % steps_per_epoch) * batch_size
         idx = jax.lax.dynamic_slice(data["perm"], (slot, pos),
                                     (1, batch_size))[0]
-        batch = {"image": jnp.take(data["images"], idx, axis=0),
-                 "label": jnp.take(data["labels"], idx, axis=0)}
+        img = jnp.take(data["images"], idx, axis=0)
         if augment == "cifar":
             # On-device crop/flip (data/augment_device.py): a dedicated
             # stream folded from the state rng — disjoint from the
-            # dropout stream, which folds in only the step.
+            # dropout stream, which folds in only the step.  Runs BEFORE
+            # dequantization: crop/flip only rearranges pixels, so it
+            # commutes bitwise with the elementwise LUT, and on a uint8-
+            # resident split any materialized pad/crop intermediate is
+            # 4x smaller.
             from distributedtensorflowexample_tpu.data.augment_device import (
                 cifar_augment_device)
             akey = jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), step)
-            batch["image"] = cifar_augment_device(batch["image"], akey)
+            img = cifar_augment_device(img, akey)
+        if img.dtype == jnp.uint8:
+            from distributedtensorflowexample_tpu.data.device_dataset import (
+                apply_dequant_lut)
+            img = apply_dequant_lut(img, data["lut"])
+        batch = {"image": img,
+                 "label": jnp.take(data["labels"], idx, axis=0)}
         if mesh is not None and mesh.size > 1:
             # Dataset + perm are replicated, so the gather is local on
             # every device; the constraint re-shards the minibatch along
@@ -294,10 +309,21 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     batches, pad labels -1 so they never match an argmax), shards each
     batch row-wise over the mesh, and jits a ``lax.scan`` over the batches
     — the whole eval is a single compiled call returning one scalar.
+    Like the train split, a quantizable split is held as uint8 (4x less
+    HBM + upload) and LUT-dequantized in the scan body — bitwise the
+    same floats (see ``data.device_dataset.dequantize_images``).
 
     Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
     """
     import numpy as np
+
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _try_quantize, dequantize_images)
+
+    dequant = None
+    q = _try_quantize(np.asarray(images))
+    if q is not None:
+        images, dequant = q
 
     n = len(labels)
     if mesh is not None and batch_size % mesh.size:
@@ -338,6 +364,8 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
 
         def body(total, xy):
             bx, by = xy
+            if dequant is not None:
+                bx = dequantize_images(bx, dequant)
             logits = state.apply_fn(variables, bx, train=False)
             correct = jnp.sum(
                 (jnp.argmax(logits, axis=-1) == by).astype(jnp.int32))
