@@ -133,7 +133,9 @@ def augment(images: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
     """
     ys, xs, flips = _draw(rng, images.shape[0])
     from distributedtensorflowexample_tpu import native
-    if native.available():
+    # f32 and u8 both have native kernels (dataio.cc crop_flip_impl<T>);
+    # anything else takes the dtype-preserving numpy fallback.
+    if native.available() and images.dtype in (np.float32, np.uint8):
         return native.augment_crop_flip(images, ys, xs, flips)
     return _augment_numpy(images, ys, xs, flips)
 
@@ -159,6 +161,9 @@ def _fused_gather_augment(src: np.ndarray, idx: np.ndarray,
 # Batcher fuses the gather with this augmentation when native is available
 # (see pipeline.Batcher._gather); draws stay in the same order as augment().
 augment.fused_native = _fused_gather_augment
+# Pure pixel rearrangement: safe to run on uint8-quantized batches
+# (Batcher only auto-quantizes under an augment that declares this).
+augment.u8_safe = True
 
 
 def _augment_numpy(images: np.ndarray, ys: np.ndarray, xs: np.ndarray,

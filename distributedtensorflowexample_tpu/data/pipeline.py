@@ -64,7 +64,19 @@ class Batcher:
                  batch_size: int, seed: int = 0, shuffle: bool = True,
                  process_index: int = 0, process_count: int = 1,
                  augment_fn: Callable[[np.ndarray, np.random.RandomState],
-                                      np.ndarray] | None = None):
+                                      np.ndarray] | None = None,
+                 quantize: str = "auto"):
+        """``quantize="auto"`` (default) keeps a bitwise-recoverable
+        8-bit split as uint8 (see ``device_dataset._try_quantize``), so
+        every per-step host gather AND host->device upload moves 4x
+        fewer bytes — the H2D copy is this path's bottleneck at small
+        step times.  The consumer step must then be built with
+        ``dequant=batcher.dequant`` (enforced at trace time by
+        ``parallel.sync.dequant_host_batch``); the device-side LUT
+        reproduces the loader's float32 values bitwise.  Crop/flip
+        augmentation is pure pixel rearrangement, so it runs on the
+        uint8 batch unchanged — the native C++ gather/augment kernels
+        have uint8 variants (dataio.cc), so the fused path applies."""
         if batch_size % process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by {process_count} processes")
@@ -72,6 +84,31 @@ class Batcher:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than the "
                 f"global batch {batch_size}; shapes downstream are static")
+        if quantize not in ("auto", "off"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        # Quantization is only valid when the augment hook is a pure
+        # pixel rearrangement (crop/flip — marked ``u8_safe`` on the
+        # function, e.g. cifar10.augment): an arbitrary float-arithmetic
+        # augment fed uint8 would promote/wrap and silently train on
+        # 0-255-scale values, the exact failure the in-step dequant
+        # guard exists to prevent.
+        u8_safe = augment_fn is None or getattr(augment_fn, "u8_safe", False)
+        self.dequant: str | None = None
+        if images.dtype == np.uint8:
+            if u8_safe:
+                self.dequant = "unit"   # raw bytes: floats are u/255
+            else:
+                # The hook expects floats; dequantize the raw split on
+                # the host rather than feed it bytes.
+                from distributedtensorflowexample_tpu.data.device_dataset \
+                    import _dequant_numpy
+                images = _dequant_numpy(images, "unit")
+        elif quantize == "auto" and u8_safe:
+            from distributedtensorflowexample_tpu.data.device_dataset import (
+                _try_quantize)
+            q = _try_quantize(np.asarray(images))
+            if q is not None:
+                images, self.dequant = q
         self._images = images
         self._labels = labels
         self._global_batch = batch_size
@@ -115,7 +152,7 @@ class Batcher:
         into the gather: one pass, no intermediate batch copy."""
         from distributedtensorflowexample_tpu import native
         use_native = (native.available()
-                      and self._images.dtype == np.float32
+                      and self._images.dtype in (np.float32, np.uint8)
                       and self._labels.dtype == np.int32)
         if not use_native:
             images = self._images[idx]

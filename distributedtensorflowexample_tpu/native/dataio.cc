@@ -108,14 +108,62 @@ int cifar_parse(const unsigned char* buf, size_t len, float* out_images,
 }
 
 // ---- Batch assembly -------------------------------------------------------
+// Templates need C++ linkage; the extern "C" block reopens for the
+// concrete entry points below.
+}  // extern "C"
+
+namespace {
 
 // out[i, :] = src[idx[i], :] — the per-step shuffled-minibatch gather.
-void gather_f32(const float* src, const int64_t* idx, int64_t batch,
-                int64_t row_elems, float* out) {
+// T = float (f32 splits) or uint8_t (quantized splits: 4x fewer bytes
+// through the gather AND the later host->device copy).
+template <typename T>
+void gather_rows(const T* src, const int64_t* idx, int64_t batch,
+                 int64_t row_elems, T* out) {
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < batch; ++i)
     std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
-                size_t(row_elems) * sizeof(float));
+                size_t(row_elems) * sizeof(T));
+}
+
+// One implementation of the crop/flip indexing for every entry point:
+// idx == nullptr means identity (output row i sources input row i).
+// Pure pixel rearrangement, so it is dtype-generic (f32 and u8).
+template <typename T>
+void crop_flip_impl(const T* src, const int64_t* idx, int64_t batch,
+                    int64_t h, int64_t w, int64_t c, const int32_t* ys,
+                    const int32_t* xs, const uint8_t* flips, T* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < batch; ++i) {
+    const T* img = src + (idx ? idx[i] : i) * h * w * c;
+    T* dst = out + i * h * w * c;
+    const int64_t y0 = ys[i], x0 = xs[i];
+    const bool flip = flips[i] != 0;
+    for (int64_t y = 0; y < h; ++y) {
+      const int64_t sy = reflect4(y0 + y, h);
+      for (int64_t x = 0; x < w; ++x) {
+        const int64_t ox = flip ? (w - 1 - x) : x;
+        const int64_t sx = reflect4(x0 + ox, w);
+        const T* s = img + (sy * w + sx) * c;
+        T* d = dst + (y * w + x) * c;
+        for (int64_t ch = 0; ch < c; ++ch) d[ch] = s[ch];
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void gather_f32(const float* src, const int64_t* idx, int64_t batch,
+                int64_t row_elems, float* out) {
+  gather_rows(src, idx, batch, row_elems, out);
+}
+
+void gather_u8(const unsigned char* src, const int64_t* idx, int64_t batch,
+               int64_t row_elems, unsigned char* out) {
+  gather_rows(src, idx, batch, row_elems, out);
 }
 
 void gather_i32(const int32_t* src, const int64_t* idx, int64_t batch,
@@ -125,40 +173,19 @@ void gather_i32(const int32_t* src, const int64_t* idx, int64_t batch,
 
 // ---- CIFAR train augmentation --------------------------------------------
 
-namespace {
-
-// One implementation of the crop/flip indexing for both entry points:
-// idx == nullptr means identity (output row i sources input row i).
-void crop_flip_impl(const float* src, const int64_t* idx, int64_t batch,
-                    int64_t h, int64_t w, int64_t c, const int32_t* ys,
-                    const int32_t* xs, const uint8_t* flips, float* out) {
-#pragma omp parallel for schedule(static)
-  for (int64_t i = 0; i < batch; ++i) {
-    const float* img = src + (idx ? idx[i] : i) * h * w * c;
-    float* dst = out + i * h * w * c;
-    const int64_t y0 = ys[i], x0 = xs[i];
-    const bool flip = flips[i] != 0;
-    for (int64_t y = 0; y < h; ++y) {
-      const int64_t sy = reflect4(y0 + y, h);
-      for (int64_t x = 0; x < w; ++x) {
-        const int64_t ox = flip ? (w - 1 - x) : x;
-        const int64_t sx = reflect4(x0 + ox, w);
-        const float* s = img + (sy * w + sx) * c;
-        float* d = dst + (y * w + x) * c;
-        for (int64_t ch = 0; ch < c; ++ch) d[ch] = s[ch];
-      }
-    }
-  }
-}
-
-}  // namespace
-
 // Random crop from a reflect-padded (pad=4) image + horizontal flip,
 // fused: the padded image is never materialized.  src/out are
-// [batch, h, w, c] f32; ys/xs in [0, 8], flips in {0, 1}.
+// [batch, h, w, c]; ys/xs in [0, 8], flips in {0, 1}.
 void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
                        int64_t c, const int32_t* ys, const int32_t* xs,
                        const uint8_t* flips, float* out) {
+  crop_flip_impl(src, nullptr, batch, h, w, c, ys, xs, flips, out);
+}
+
+void augment_crop_flip_u8(const unsigned char* src, int64_t batch, int64_t h,
+                          int64_t w, int64_t c, const int32_t* ys,
+                          const int32_t* xs, const uint8_t* flips,
+                          unsigned char* out) {
   crop_flip_impl(src, nullptr, batch, h, w, c, ys, xs, flips, out);
 }
 
@@ -168,6 +195,13 @@ void augment_crop_flip(const float* src, int64_t batch, int64_t h, int64_t w,
 void gather_augment_f32(const float* src, const int64_t* idx, int64_t batch,
                         int64_t h, int64_t w, int64_t c, const int32_t* ys,
                         const int32_t* xs, const uint8_t* flips, float* out) {
+  crop_flip_impl(src, idx, batch, h, w, c, ys, xs, flips, out);
+}
+
+void gather_augment_u8(const unsigned char* src, const int64_t* idx,
+                       int64_t batch, int64_t h, int64_t w, int64_t c,
+                       const int32_t* ys, const int32_t* xs,
+                       const uint8_t* flips, unsigned char* out) {
   crop_flip_impl(src, idx, batch, h, w, c, ys, xs, flips, out);
 }
 

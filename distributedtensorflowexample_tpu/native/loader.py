@@ -74,16 +74,26 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.gather_f32.argtypes = [_F32, _I64, ctypes.c_int64, ctypes.c_int64,
                                _F32]
     lib.gather_f32.restype = None
+    lib.gather_u8.argtypes = [_U8, _I64, ctypes.c_int64, ctypes.c_int64, _U8]
+    lib.gather_u8.restype = None
     lib.gather_i32.argtypes = [_I32, _I64, ctypes.c_int64, _I32]
     lib.gather_i32.restype = None
     lib.augment_crop_flip.argtypes = [_F32, ctypes.c_int64, ctypes.c_int64,
                                       ctypes.c_int64, ctypes.c_int64, _I32,
                                       _I32, _U8, _F32]
     lib.augment_crop_flip.restype = None
+    lib.augment_crop_flip_u8.argtypes = [_U8, ctypes.c_int64, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_int64,
+                                         _I32, _I32, _U8, _U8]
+    lib.augment_crop_flip_u8.restype = None
     lib.gather_augment_f32.argtypes = [_F32, _I64, ctypes.c_int64,
                                        ctypes.c_int64, ctypes.c_int64,
                                        ctypes.c_int64, _I32, _I32, _U8, _F32]
     lib.gather_augment_f32.restype = None
+    lib.gather_augment_u8.argtypes = [_U8, _I64, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_int64, _I32, _I32, _U8, _U8]
+    lib.gather_augment_u8.restype = None
     lib.omp_max_threads.argtypes = []
     lib.omp_max_threads.restype = ctypes.c_int
 
@@ -168,48 +178,62 @@ def parse_cifar(raw: bytes) -> tuple[np.ndarray, np.ndarray]:
 
 
 def gather(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
-    """out[i] = src[idx[i]] — parallel row gather (float32 ND or int32 1D)."""
+    """out[i] = src[idx[i]] — parallel row gather (f32/u8 ND or i32 1D;
+    uint8 moves 4x fewer bytes — the quantized host path)."""
     lib = _get()
     idx = np.ascontiguousarray(idx, dtype=np.int64)
     if src.dtype == np.int32 and src.ndim == 1:
         out = np.empty(idx.size, dtype=np.int32)
         lib.gather_i32(np.ascontiguousarray(src), idx, idx.size, out)
         return out
-    if src.dtype != np.float32:
-        raise TypeError(f"native gather supports f32/i32, got {src.dtype}")
+    if src.dtype not in (np.float32, np.uint8):
+        raise TypeError(f"native gather supports f32/u8/i32, got {src.dtype}")
     src = np.ascontiguousarray(src)
     row = int(np.prod(src.shape[1:], dtype=np.int64))
-    out = np.empty((idx.size,) + src.shape[1:], dtype=np.float32)
-    lib.gather_f32(src.reshape(-1), idx, idx.size, row, out.reshape(-1))
+    out = np.empty((idx.size,) + src.shape[1:], dtype=src.dtype)
+    fn = lib.gather_f32 if src.dtype == np.float32 else lib.gather_u8
+    fn(src.reshape(-1), idx, idx.size, row, out.reshape(-1))
     return out
 
 
 def gather_augment(src: np.ndarray, idx: np.ndarray, ys: np.ndarray,
                    xs: np.ndarray, flips: np.ndarray) -> np.ndarray:
-    """Fused row gather + reflect-pad-4 crop + hflip for [N,H,W,C] f32."""
+    """Fused row gather + reflect-pad-4 crop + hflip for [N,H,W,C] f32 or
+    uint8 (dtype-preserving: pure pixel rearrangement)."""
     lib = _get()
-    src = np.ascontiguousarray(src, dtype=np.float32)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    src = np.ascontiguousarray(src)
+    if src.dtype not in (np.float32, np.uint8):
+        raise TypeError(f"native gather_augment supports f32/u8, "
+                        f"got {src.dtype}")
     n, h, w, c = (idx.size,) + src.shape[1:]
-    out = np.empty((n, h, w, c), dtype=np.float32)
-    lib.gather_augment_f32(src.reshape(-1), idx, n, h, w, c,
-                           np.ascontiguousarray(ys, dtype=np.int32),
-                           np.ascontiguousarray(xs, dtype=np.int32),
-                           np.ascontiguousarray(flips, dtype=np.uint8),
-                           out.reshape(-1))
+    out = np.empty((n, h, w, c), dtype=src.dtype)
+    fn = (lib.gather_augment_f32 if src.dtype == np.float32
+          else lib.gather_augment_u8)
+    fn(src.reshape(-1), idx, n, h, w, c,
+       np.ascontiguousarray(ys, dtype=np.int32),
+       np.ascontiguousarray(xs, dtype=np.int32),
+       np.ascontiguousarray(flips, dtype=np.uint8),
+       out.reshape(-1))
     return out
 
 
 def augment_crop_flip(images: np.ndarray, ys: np.ndarray, xs: np.ndarray,
                       flips: np.ndarray) -> np.ndarray:
-    """Reflect-pad-4 random crop + hflip for [N,H,W,C] f32 batches."""
+    """Reflect-pad-4 random crop + hflip for [N,H,W,C] f32/u8 batches
+    (dtype-preserving)."""
     lib = _get()
-    images = np.ascontiguousarray(images, dtype=np.float32)
+    images = np.ascontiguousarray(images)
+    if images.dtype not in (np.float32, np.uint8):
+        raise TypeError(f"native augment supports f32/u8, "
+                        f"got {images.dtype}")
     n, h, w, c = images.shape
     out = np.empty_like(images)
-    lib.augment_crop_flip(images.reshape(-1), n, h, w, c,
-                          np.ascontiguousarray(ys, dtype=np.int32),
-                          np.ascontiguousarray(xs, dtype=np.int32),
-                          np.ascontiguousarray(flips, dtype=np.uint8),
-                          out.reshape(-1))
+    fn = (lib.augment_crop_flip if images.dtype == np.float32
+          else lib.augment_crop_flip_u8)
+    fn(images.reshape(-1), n, h, w, c,
+       np.ascontiguousarray(ys, dtype=np.int32),
+       np.ascontiguousarray(xs, dtype=np.int32),
+       np.ascontiguousarray(flips, dtype=np.uint8),
+       out.reshape(-1))
     return out

@@ -257,10 +257,20 @@ def _build_shard_map_step(num_workers: int, period: int,
 
 def make_async_train_step(num_workers: int, period: int,
                           label_smoothing: float = 0.0, ce_impl: str = "xla",
-                          mesh=None) -> Callable:
-    """Build the jitted host-fed local-SGD step over worker-tiled state."""
-    return jax.jit(_build_async_step_fn(num_workers, period, label_smoothing,
-                                        ce_impl, mesh), donate_argnums=0)
+                          mesh=None, dequant: str | None = None) -> Callable:
+    """Build the jitted host-fed local-SGD step over worker-tiled state.
+
+    ``dequant``: spec for host-fed uint8 batches (``batcher.dequant``,
+    see sync.dequant_host_batch)."""
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        dequant_host_batch)
+    inner = _build_async_step_fn(num_workers, period, label_smoothing,
+                                 ce_impl, mesh)
+
+    def step(state: TrainState, batch):
+        return inner(state, dequant_host_batch(batch, dequant))
+
+    return jax.jit(step, donate_argnums=0)
 
 
 def make_indexed_async_train_step(num_workers: int, period: int,

@@ -207,13 +207,40 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
     return step
 
 
+def dequant_host_batch(batch, dequant: str | None):
+    """Dequantize a HOST-FED uint8 batch in-step through a LUT closure
+    constant (``data.device_dataset.make_dequant_lut`` — 4x less H2D
+    per step than uploading float32).  Float batches pass through.  A
+    uint8 batch with no spec is a TRACE-TIME error: silently training
+    on raw 0-255 bytes is the failure this guard exists to prevent —
+    pass ``dequant=batcher.dequant`` (``data.pipeline.Batcher``)."""
+    img = batch["image"]
+    if img.dtype != jnp.uint8:
+        return batch
+    if dequant is None:
+        raise TypeError(
+            "host-fed batch images are uint8 but the train step was "
+            "built without dequant=; pass dequant=batcher.dequant")
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        dequantize_images)
+    return dict(batch, image=dequantize_images(img, dequant))
+
+
 def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
                     mesh=None, num_replicas: int = 1,
-                    replicas_to_aggregate: int = 0) -> Callable:
-    """Build the jitted (state, batch) -> (state, metrics) step."""
-    return jax.jit(_build_step_fn(label_smoothing, ce_impl, mesh,
-                                  num_replicas, replicas_to_aggregate),
-                   donate_argnums=0)
+                    replicas_to_aggregate: int = 0,
+                    dequant: str | None = None) -> Callable:
+    """Build the jitted (state, batch) -> (state, metrics) step.
+
+    ``dequant``: spec for HOST-FED uint8 batches (``batcher.dequant``);
+    the resident/indexed path dequantizes in its gather instead."""
+    inner = _build_step_fn(label_smoothing, ce_impl, mesh,
+                           num_replicas, replicas_to_aggregate)
+
+    def step(state: TrainState, batch):
+        return inner(state, dequant_host_batch(batch, dequant))
+
+    return jax.jit(step, donate_argnums=0)
 
 
 def make_indexed_train_step(batch_size: int, steps_per_epoch: int,

@@ -219,7 +219,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     elif is_async:
         train_step = make_async_train_step(num_replicas, cfg.async_period,
                                            cfg.label_smoothing,
-                                           ce_impl=ce_impl, mesh=mesh)
+                                           ce_impl=ce_impl, mesh=mesh,
+                                           dequant=batcher.dequant)
     elif use_device_data:
         train_step = make_indexed_train_step(
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
@@ -230,7 +231,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     else:
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
                                      mesh=mesh, num_replicas=num_replicas,
-                                     replicas_to_aggregate=cfg.replicas_to_aggregate)
+                                     replicas_to_aggregate=cfg.replicas_to_aggregate,
+                                     dequant=batcher.dequant)
     with mesh:
         loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger,
                          steps_per_call=steps_per_call)

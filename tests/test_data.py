@@ -108,3 +108,96 @@ def test_batcher_process_sharding_disjoint_and_covering():
         s0, s1 = next(b0)["label"], next(b1)["label"]
         assert len(set(s0) & set(s1)) == 0
         assert len(set(s0) | set(s1)) == 16
+
+
+# ---- host-fed uint8 path (round 4) --------------------------------------
+# The host path's bottleneck is the per-step H2D copy; a quantizable
+# split stays uint8 through gather + upload and dequantizes in-step.
+
+def test_batcher_auto_quantizes_and_training_is_bitwise(tmp_path):
+    import jax
+    import optax
+
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel.sync import make_train_step
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256)
+    model = build_model("softmax")
+
+    def run(quantize):
+        b = Batcher(x, y, 32, seed=3, quantize=quantize)
+        state = TrainState.create(model, optax.sgd(0.1),
+                                  np.zeros((32, 28, 28, 1), np.float32))
+        step = make_train_step(dequant=b.dequant)
+        for _ in range(6):
+            batch = next(b)
+            if quantize == "auto":
+                assert batch["image"].dtype == np.uint8
+            state, metrics = step(state, batch)
+        return (np.asarray(jax.tree.leaves(state.params)[0]),
+                float(metrics["loss"]))
+
+    p_u, l_u = run("auto")
+    p_f, l_f = run("off")
+    assert l_u == l_f
+    np.testing.assert_array_equal(p_u, p_f)
+
+
+def test_batcher_uint8_augment_is_bitwise(tmp_path):
+    """Crop/flip is pure rearrangement: augmenting the uint8 batch then
+    dequantizing equals the float path exactly (same rng draw order)."""
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _dequant_numpy)
+
+    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=128,
+                        normalize=False)
+    b_u = Batcher(x, y, 16, seed=5, augment_fn=augment)
+    b_f = Batcher(x, y, 16, seed=5, augment_fn=augment, quantize="off")
+    assert b_u.dequant == "unit" and b_f.dequant is None
+    for _ in range(4):
+        bu, bf = next(b_u), next(b_f)
+        assert bu["image"].dtype == np.uint8
+        np.testing.assert_array_equal(_dequant_numpy(bu["image"], "unit"),
+                                      bf["image"])
+        np.testing.assert_array_equal(bu["label"], bf["label"])
+
+
+def test_uint8_batch_without_dequant_is_a_loud_error(tmp_path):
+    """The guard that motivated the design: a uint8 batch reaching a
+    step built without a dequant spec must fail at trace time, never
+    silently train on raw 0-255 bytes."""
+    import optax
+    import pytest
+
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel.sync import make_train_step
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    b = Batcher(x, y, 32, seed=0)
+    state = TrainState.create(build_model("softmax"), optax.sgd(0.1),
+                              np.zeros((32, 28, 28, 1), np.float32))
+    step = make_train_step()          # no dequant spec
+    with pytest.raises(TypeError, match="dequant"):
+        step(state, next(b))
+
+
+def test_custom_float_augment_disables_quantization(tmp_path):
+    """An arbitrary float-arithmetic augment hook must keep the split
+    float32 (auto-quantization only engages under u8-safe rearrangement
+    augments) — and a raw uint8 split is host-dequantized for it."""
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    noisy = lambda im, rng: im + rng.normal(0, 0.1, im.shape).astype(im.dtype)
+    b = Batcher(x, y, 32, seed=0, augment_fn=noisy)
+    assert b.dequant is None
+    assert next(b)["image"].dtype == np.float32
+
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _dequant_numpy)
+    u8 = np.rint(x * 255.0).astype(np.uint8)
+    b2 = Batcher(u8, y, 32, seed=0, augment_fn=noisy)
+    assert b2.dequant is None
+    batch = next(b2)
+    assert batch["image"].dtype == np.float32
+    assert batch["image"].max() <= 2.0          # unit scale, not 0-255

@@ -156,3 +156,54 @@ def test_batcher_fused_augment_parity():
         loader._LIB, loader._FAILED = saved, False
     np.testing.assert_array_equal(batch_native["image"], batch_numpy["image"])
     np.testing.assert_array_equal(batch_native["label"], batch_numpy["label"])
+
+
+# ---- uint8 variants (round 4: quantized host path) ----------------------
+
+def test_gather_u8_matches_fancy_indexing():
+    rng = np.random.RandomState(3)
+    src = rng.randint(0, 256, size=(50, 8, 8, 3), dtype=np.uint8)
+    idx = rng.randint(0, 50, size=16).astype(np.int64)
+    out = native.gather(src, idx)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_augment_u8_matches_numpy_fallback():
+    rng = np.random.RandomState(4)
+    images = rng.randint(0, 256, size=(12, 32, 32, 3), dtype=np.uint8)
+    ys = rng.randint(0, 9, size=12).astype(np.int32)
+    xs = rng.randint(0, 9, size=12).astype(np.int32)
+    flips = (rng.rand(12) < 0.5)
+    out = native.augment_crop_flip(images, ys, xs, flips)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, _augment_numpy(images, ys, xs, flips))
+
+
+def test_fused_gather_augment_u8_matches_two_step():
+    rng = np.random.RandomState(5)
+    src = rng.randint(0, 256, size=(40, 32, 32, 3), dtype=np.uint8)
+    idx = rng.randint(0, 40, size=10).astype(np.int64)
+    ys = rng.randint(0, 9, size=10).astype(np.int32)
+    xs = rng.randint(0, 9, size=10).astype(np.int32)
+    flips = (rng.rand(10) < 0.5)
+    fused = native.gather_augment(src, idx, ys, xs, flips)
+    assert fused.dtype == np.uint8
+    np.testing.assert_array_equal(
+        fused, _augment_numpy(src[idx], ys, xs, flips))
+
+
+def test_uint8_augment_commutes_with_dequant():
+    """The whole-path invariant the quantized pipeline rests on:
+    augment(uint8) then LUT-dequant == dequant then augment."""
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _dequant_numpy)
+    rng = np.random.RandomState(6)
+    images = rng.randint(0, 256, size=(8, 32, 32, 3), dtype=np.uint8)
+    ys = rng.randint(0, 9, size=8).astype(np.int32)
+    xs = rng.randint(0, 9, size=8).astype(np.int32)
+    flips = (rng.rand(8) < 0.5)
+    a = _dequant_numpy(native.augment_crop_flip(images, ys, xs, flips),
+                       "cifar")
+    b = _augment_numpy(_dequant_numpy(images, "cifar"), ys, xs, flips)
+    np.testing.assert_array_equal(a, b)
