@@ -119,6 +119,38 @@ def bench_gather_augment(n_src: int = 50000, batch: int = 256) -> None:
            "numpy_images_per_sec": round(batch / t_numpy, 1)})
 
 
+def bench_gather_augment_u8(n_src: int = 50000, batch: int = 256) -> None:
+    """The quantized host path (round 4): the same fused gather+crop+flip
+    on a uint8-resident split moves 4x fewer bytes.  The speedup baseline
+    is the f32 NATIVE fused path — the line reads as what uint8 storage
+    buys ON TOP of the C++ runtime (the upload saving is additional)."""
+    from distributedtensorflowexample_tpu import native
+    from distributedtensorflowexample_tpu.data.cifar10 import _draw
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _dequant_numpy)
+
+    rng = np.random.RandomState(4)
+    src8 = rng.randint(0, 256, size=(n_src, 32, 32, 3), dtype=np.uint8)
+    src32 = _dequant_numpy(src8, "unit")
+    idx = rng.randint(0, n_src, size=batch).astype(np.int64)
+    ys, xs, flips = _draw(np.random.RandomState(5), batch)
+
+    # Commutation check before timing: u8 result dequantizes to exactly
+    # the f32 path's output.
+    np.testing.assert_array_equal(
+        _dequant_numpy(native.gather_augment(src8, idx, ys, xs, flips),
+                       "unit"),
+        native.gather_augment(src32, idx, ys, xs, flips))
+    t_u8 = _time(lambda: native.gather_augment(src8, idx, ys, xs, flips), 20)
+    t_f32 = _time(lambda: native.gather_augment(src32, idx, ys, xs, flips),
+                  20)
+    _emit("gather_augment_native_u8_images_per_sec", batch / t_u8,
+          "images/sec", t_f32 / t_u8,
+          {"batch": batch, "source_rows": n_src,
+           "f32_images_per_sec": round(batch / t_f32, 1),
+           "bytes_per_image_u8": 3072, "bytes_per_image_f32": 12288})
+
+
 def main() -> None:
     from distributedtensorflowexample_tpu import native
 
@@ -132,6 +164,7 @@ def main() -> None:
     bench_cifar_parse()
     bench_idx_parse()
     bench_gather_augment()
+    bench_gather_augment_u8()
 
 
 if __name__ == "__main__":

@@ -151,10 +151,12 @@ def test_bench_input_stages(capsys):
     bench_input.bench_cifar_parse(n_records=50)
     bench_input.bench_idx_parse(n=200)
     bench_input.bench_gather_augment(n_src=300, batch=16)
+    bench_input.bench_gather_augment_u8(n_src=300, batch=16)
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert [l["metric"] for l in lines] == [
         "cifar_parse_native_mb_per_sec", "idx_parse_native_mb_per_sec",
-        "gather_augment_native_images_per_sec"]
+        "gather_augment_native_images_per_sec",
+        "gather_augment_native_u8_images_per_sec"]
     assert all(l["value"] > 0 and l["vs_baseline"] > 0 for l in lines)
 
 
