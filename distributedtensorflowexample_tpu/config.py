@@ -95,6 +95,10 @@ class RunConfig:
                                     # (lax.scan); device_data path only.
                                     # Amortizes dispatch latency like Keras
                                     # steps_per_execution
+    quantize: str = "auto"          # auto | off — hold 8-bit-exact splits
+                                    # as uint8 (4x less HBM + gather/upload
+                                    # bytes; in-step LUT dequant is bitwise-
+                                    # identical), resident AND host paths
 
     @property
     def ps_host_list(self) -> list[str]:
@@ -165,6 +169,11 @@ _FLAG_HELP = {
     "steps_per_loop": "SGD steps fused per compiled call (lax.scan over "
                       "the device-resident dataset); like Keras "
                       "steps_per_execution",
+    "quantize": "auto | off — store 8-bit-exact splits as uint8 in "
+                "HBM/host memory (4x less gather and upload traffic; the "
+                "in-step LUT dequantization is bitwise-identical to "
+                "float32 storage, verified at build time); off = always "
+                "float32",
 }
 
 

@@ -118,7 +118,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         batcher = Batcher(train_x, train_y, global_batch, seed=cfg.seed,
                           process_index=jax.process_index(),
                           process_count=jax.process_count(),
-                          augment_fn=cifar_augment if augment else None)
+                          augment_fn=cifar_augment if augment else None,
+                          quantize=cfg.quantize)
         batches = DevicePrefetcher(batcher, sharding=data_shard)
 
     model = build_model(model_name, dropout=cfg.dropout,
@@ -204,7 +205,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         # the restored global step.
         ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh,
                            seed=cfg.seed, start_step=int(state.step),
-                           steps_per_next=steps_per_call)
+                           steps_per_next=steps_per_call,
+                           quantize=cfg.quantize)
         batches = ds
     elif cfg.steps_per_loop > 1:
         raise ValueError("--steps_per_loop > 1 requires the "

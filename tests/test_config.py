@@ -120,3 +120,9 @@ def test_every_trainer_help_exits_clean(capsys):
             mod.main(["--help"])
         assert exc.value.code == 0
         assert "--train_steps" in capsys.readouterr().out
+
+
+def test_quantize_flag_parses_and_validates():
+    cfg = parse_flags(["--quantize", "off"])
+    assert cfg.quantize == "off"
+    assert RunConfig().quantize == "auto"
