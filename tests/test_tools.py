@@ -36,7 +36,8 @@ def _write_shim(bindir, name, body):
 
 
 def _run_watcher(tmp_path, *, bench_age_s=None, cap_age_s=None,
-                 probe="fail_once", done_when, timeout_s=60, settle_s=0.0):
+                 probe="fail_once", stale_s=None, done_when, timeout_s=60,
+                 settle_s=0.0):
     """Start the real tools/tpu_watch.sh under shims and stop it once
     ``done_when(log_text)`` is true (or on timeout).
 
@@ -83,6 +84,8 @@ def _run_watcher(tmp_path, *, bench_age_s=None, cap_age_s=None,
                RECOVERED_MARKER=str(marker),
                CAPTURE_PIDFILE=str(pidfile),
                PROBE_INTERVAL_S="1")
+    if stale_s is not None:
+        env["STALE_S"] = str(stale_s)
     proc = subprocess.Popen(["bash", os.path.join(REPO, "tools",
                                                   "tpu_watch.sh")],
                             env=env, cwd=REPO,
@@ -232,3 +235,16 @@ def test_capture_pidfile_written_for_any_launch_spelling(tmp_path):
             proc.kill()
             proc.wait(timeout=10)
     assert not pidfile.exists()       # EXIT trap cleaned its own pidfile
+
+
+def test_kill_threshold_floors_at_outage_duration(tmp_path):
+    """kill_over = max(STALE_S, outage_duration + 60 s): even with a tiny
+    STALE_S, a bench YOUNGER than the outage window must survive the edge
+    — it started DURING the outage (e.g. a parked bench in its own
+    probe-retry loop) and is about to become the capture."""
+    log, launches, _, _ = _run_watcher(
+        tmp_path, bench_age_s=30, probe="fail_twice", stale_s=1,
+        done_when=lambda log: "young bench" in log)
+    assert "young bench already capturing; not launching" in log
+    assert "killing" not in log
+    assert not launches.exists()
