@@ -6,18 +6,22 @@ file runs the whole thing at shrunken sizes: same code, same workload
 set, same emit contract — only the module-level sizing knobs change.
 
 OPT-IN, not part of the default suite: even at minimal sizes the run
-costs ~20 min on this host — each scanned step pays ~0.5-2 s of
+costs many minutes on this host — each scanned step pays
 collective-rendezvous spin on the oversubscribed virtual mesh, and that
 cost is execution, not compile, so the persistent cache can't absorb
 it.  Run it after any bench.py change:
 
-    DISTTF_BENCH_E2E=1 DISTTF_INNER_PYTEST=1 DISTTF_TEST_DEVICES=2 \\
+    DISTTF_BENCH_E2E=1 DISTTF_INNER_PYTEST=1 DISTTF_TEST_DEVICES=1 \\
         python -m pytest tests/test_bench_e2e.py -q
 
-DISTTF_TEST_DEVICES=2 is effectively required, not just recommended:
-the sizing adapts to any device count, but at the default 8 virtual
-devices the per-step rendezvous cost roughly quadruples and a run was
-still going at 77 minutes (validated green at 2 devices in ~14 min).
+DISTTF_TEST_DEVICES matters (sizing adapts to any count, cost doesn't):
+1 virtual device is BOTH the fastest (~9 min warm — no collectives at
+all) AND the driver's actual bench topology (one real chip = mesh of
+1), so it is the default recommendation (round-3 weak item: the CI
+config didn't match the driver's).  2 devices (~14 min) additionally
+exercises the collective path end-to-end; at the conftest default of 8
+the per-step rendezvous cost quadruples and a run was still going at
+77 minutes.
 """
 
 import json
