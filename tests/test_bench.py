@@ -564,3 +564,33 @@ def test_collective_traffic_parsing():
     assert out["all-gather"]["count"] == 1
     assert out["all-gather"]["bytes"] == 8 * 4 * 4
     assert "collective-permute" not in out
+
+
+def test_headline_only_mode(monkeypatch, capsys):
+    """BENCH_HEADLINE_ONLY=1 (capture phase 1): the contract metric +
+    same-window roofline only — one sweep half, no side workloads — so
+    a short recovery window spends its first minutes on the headline
+    and the never-yet-captured ResNet profile, not the full run."""
+    calls = []
+
+    def fake_sweep(unrolls, make_fn, steps_for, err_prefix, errors):
+        calls.append(err_prefix)
+        return (50.0, 16, [50.0], {"16": [50.0]})
+
+    def boom(*a, **k):
+        raise AssertionError("side workload must not run in headline-only")
+
+    monkeypatch.setattr(bench, "HEADLINE_ONLY", True)
+    monkeypatch.setattr(bench, "_sweep", fake_sweep)
+    monkeypatch.setattr(bench, "_roofline_probe", lambda *a, **k: [100.0])
+    monkeypatch.setattr(bench, "_make", boom)
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 2          # provisional + headline, nothing else
+    line = lines[-1]
+    assert line["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    assert line["unit"] == "steps/sec/chip"
+    assert line["detail"]["headline_only"] is True
+    assert line["detail"]["vs_roofline"] == 0.5
+    assert "errors" not in line["detail"]   # no side workload ever ran
+    assert calls == ["sweep_"]              # exactly one sweep half

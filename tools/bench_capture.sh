@@ -1,9 +1,17 @@
 #!/bin/bash
-# One full on-chip capture: bench.py (headline measured first,
-# watchdogged - see docs/DESIGN.md §10), then bench_profile.py (ResNet
-# attribution + jax.profiler trace), then the trace tarred into the repo
-# if it is small enough to commit.  Launched by tools/tpu_watch.sh on
-# backend recovery, or by hand:  setsid nohup tools/bench_capture.sh &
+# One on-chip capture window, ordered by artifact value (round-3 data:
+# windows between outages ran as short as ~9 minutes, and the ResNet
+# attribution has never yet executed on hardware):
+#   phase 1  bench.py BENCH_HEADLINE_ONLY=1  -> the contract metric +
+#            same-window roofline, fastest possible ($OUT_HEADLINE)
+#   phase 2  bench_profile.py                -> ResNet attribution +
+#            jax.profiler trace ($PROFILE_OUT, trace tarred if small)
+#   phase 3  bench.py (full)                 -> all six workload lines
+#            ($OUT) — spends whatever window remains
+# Each phase's output is kept even if a later phase dies; a watchdog
+# exit (rc=3: backend provably wedged) stops the remaining phases.
+# Launched by tools/tpu_watch.sh on backend recovery, or by hand:
+#   setsid nohup tools/bench_capture.sh &
 #
 # Detached on purpose: a tool-timeout SIGKILL on a chip-holding process
 # wedges the shared tunnel (verify skill), so captures must never run
@@ -11,6 +19,7 @@
 
 cd "$(dirname "$0")/.." || exit 1
 OUT=${OUT:-BENCH_auto_r04.json}
+OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r04.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_r04.json}
 TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r04.tgz}
 TRACE_DIR=${TRACE_DIR:-/tmp/resnet_trace}
@@ -31,44 +40,54 @@ trap cleanup_pidfile EXIT
 # Detached capture: no outer harness timeout, so the full 40-min retry
 # budget is affordable here (bench.py's default shrank to 900 s to fit
 # under the DRIVER's ~23-25-min kill — that constraint does not apply
-# to this path).  Exported so bench_profile.py (same module constant)
-# gets it too.
+# to this path).  Exported so every phase gets it.
 export BENCH_RETRY_BUDGET_S=${BENCH_RETRY_BUDGET_S:-2400}
 
-date -u >> "$LOG"
-python bench.py > "$OUT.tmp" 2>> "$LOG"
-rc=$?
-# Keep whatever landed even on failure: each line is flushed as it
-# completes, so a partial file is a valid partial capture.
-if [ -s "$OUT.tmp" ]; then mv "$OUT.tmp" "$OUT"; else rm -f "$OUT.tmp"; fi
-echo "bench rc=$rc" >> "$LOG"
+# Keep whatever landed even on a failed phase: every line is flushed as
+# it completes, so a partial file is a valid partial capture.
+keep() { # $1=tmp $2=final
+  if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
+}
 
-if [ "$rc" -eq 3 ]; then
-  # bench's watchdog fired: the backend is provably wedged.  Running the
-  # profile against it would burn another BENCH_TOTAL_BUDGET_S while
-  # this live process suppresses nothing useful — stop here; the next
-  # recovery window relaunches the whole capture.
-  echo "profile skipped: bench watchdog fired (backend wedged)" >> "$LOG"
-else
-  # A stale trace from an earlier run must not get tarred as THIS
-  # window's artifact.
-  rm -rf "$TRACE_DIR"
-  python bench_profile.py --trace_dir "$TRACE_DIR" > "$PROFILE_OUT.tmp" 2>> "$LOG"
-  rc2=$?
-  if [ -s "$PROFILE_OUT.tmp" ]; then
-    mv "$PROFILE_OUT.tmp" "$PROFILE_OUT"
+date -u >> "$LOG"
+
+# --- phase 1: headline only -----------------------------------------------
+BENCH_HEADLINE_ONLY=1 python bench.py > "$OUT_HEADLINE.tmp" 2>> "$LOG"
+rc1=$?
+keep "$OUT_HEADLINE.tmp" "$OUT_HEADLINE"
+echo "headline-only bench rc=$rc1" >> "$LOG"
+if [ "$rc1" -eq 3 ]; then
+  echo "remaining phases skipped: watchdog fired (backend wedged)" >> "$LOG"
+  date -u >> "$LOG"
+  exit 3
+fi
+
+# --- phase 2: ResNet attribution + trace ----------------------------------
+# A stale trace from an earlier run must not get tarred as THIS window's
+# artifact.
+rm -rf "$TRACE_DIR"
+python bench_profile.py --trace_dir "$TRACE_DIR" > "$PROFILE_OUT.tmp" 2>> "$LOG"
+rc2=$?
+keep "$PROFILE_OUT.tmp" "$PROFILE_OUT"
+echo "profile rc=$rc2" >> "$LOG"
+if [ "$rc2" -eq 0 ] && [ -d "$TRACE_DIR" ]; then
+  sz=$(du -sm "$TRACE_DIR" | cut -f1)
+  if [ "$sz" -le 25 ]; then
+    tar czf "$TRACE_TGZ" -C "$(dirname "$TRACE_DIR")" "$(basename "$TRACE_DIR")"
+    echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
   else
-    rm -f "$PROFILE_OUT.tmp"
-  fi
-  echo "profile rc=$rc2" >> "$LOG"
-  if [ "$rc2" -eq 0 ] && [ -d "$TRACE_DIR" ]; then
-    sz=$(du -sm "$TRACE_DIR" | cut -f1)
-    if [ "$sz" -le 25 ]; then
-      tar czf "$TRACE_TGZ" -C "$(dirname "$TRACE_DIR")" "$(basename "$TRACE_DIR")"
-      echo "trace tarred (${sz}MB) -> $TRACE_TGZ" >> "$LOG"
-    else
-      echo "trace too big to commit (${sz}MB), left in $TRACE_DIR" >> "$LOG"
-    fi
+    echo "trace too big to commit (${sz}MB), left in $TRACE_DIR" >> "$LOG"
   fi
 fi
+if [ "$rc2" -eq 3 ]; then
+  echo "full bench skipped: profile watchdog fired (backend wedged)" >> "$LOG"
+  date -u >> "$LOG"
+  exit 3
+fi
+
+# --- phase 3: full bench --------------------------------------------------
+python bench.py > "$OUT.tmp" 2>> "$LOG"
+rc3=$?
+keep "$OUT.tmp" "$OUT"
+echo "full bench rc=$rc3" >> "$LOG"
 date -u >> "$LOG"
