@@ -223,15 +223,41 @@ def _load_baselines() -> dict:
     return {}
 
 
+# Flipped (permanently — the process is exiting) by the SIGTERM handler:
+# print()/flush() on the shared BufferedWriter raise RuntimeError
+# ("reentrant call") if the signal landed while the main thread was
+# mid-write to stdout; os.write to the fd has no such guard.
+_EMIT_RAW = False
+
+
+def _println(line: str) -> None:
+    """One record line to stdout — signal-safe in _EMIT_RAW mode."""
+    if _EMIT_RAW:
+        # Loop on short writes: a pipe with a partly-full buffer may
+        # accept fewer bytes than a record larger than PIPE_BUF, and a
+        # torn '{...partial' tail is exactly what this path must never
+        # leave.  EPIPE/EAGAIN: the reader is gone or stalled — nothing
+        # more can be recorded, give up rather than spin.
+        buf = (line + "\n").encode()
+        while buf:
+            try:
+                n = os.write(1, buf)
+            except OSError:
+                return
+            buf = buf[n:]
+    else:
+        print(line, flush=True)
+
+
 def _emit(metric: str, per_chip: float, baselines: dict, detail: dict) -> None:
     baseline = baselines.get(metric)
-    print(json.dumps({
+    _println(json.dumps({
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": "steps/sec/chip",
         "vs_baseline": round(per_chip / baseline, 4) if baseline else 1.0,
         "detail": detail,
-    }), flush=True)
+    }))
 
 
 def _measure(step, ds, state, steps: int, unroll: int,
@@ -464,18 +490,19 @@ def main() -> None:
             # list() snapshots first: the watchdog thread may serialize
             # while the main thread is still appending.
             detail["errors"] = {k: v[:300] for k, v in list(errors_.items())}
-        print(json.dumps({
+        _println(json.dumps({
             "metric": "mnist_cnn_sync_steps_per_sec_per_chip",
             "value": 0.0, "unit": "unavailable", "vs_baseline": 0.0,
             "detail": detail,
-        }), flush=True)
+        }))
 
     def final_once(fn) -> None:
         with final_guard:
             if final_done[0]:
                 return
             fn()
-            sys.stdout.flush()
+            if not _EMIT_RAW:
+                sys.stdout.flush()
             # Marked done AFTER fn(): if a SIGTERM lands between the
             # mark and the print, the handler would see done, no-op, and
             # os._exit with NO final line ever emitted.  The cost is the
@@ -518,30 +545,69 @@ def main() -> None:
         # time.sleep / subprocess waits return early — so this covers
         # every non-wedged kill; the watchdog covers the wedged ones.
         # os._exit: the process is being killed anyway, skip atexit.
-        # Leading newline FIRST: if the signal interrupted main() mid-
-        # print, the physical line is torn ('{...partial') — without a
-        # terminator the handler's JSON would concatenate onto it and
-        # the driver's last-line parse would see invalid JSON.  A blank
-        # line in the normal case is harmless to a line-based parser.
-        print(flush=True)
-        if _PROBE_PROC is not None:
-            attempts.append("probe still in flight at sigterm "
-                            "(no verdict on backend state)")
-        final_once(lambda: fire_final(
-            "sigterm",
-            f"sigterm at t+{time.time() - t_start:.0f}s: killed by the "
-            "outer harness; lines above this one are valid completed "
-            "measurements"))
-        proc = _PROBE_PROC
-        if proc is not None:
-            # Don't orphan a probe child wedged in axon init (it would
-            # outlive us holding tunnel state).  TERM only — no time for
-            # the usual grace period under the killer's -k window.
+        # Every write here goes through os.write (_EMIT_RAW): a print()
+        # would raise "reentrant call" RuntimeError if the signal landed
+        # while the main thread was mid-print, and that exception would
+        # escape the handler and skip both the record and the exit code.
+        # The try/finally makes os._exit(143) unconditional regardless.
+        global _EMIT_RAW
+        _EMIT_RAW = True
+        try:
+            # Serialize on final_guard BEFORE touching fd 1: the watchdog
+            # thread emits its final record while holding it, and a raw
+            # newline written between that print's flush chunks would
+            # tear ITS record (the buffer lock the old print() serialized
+            # on is exactly what os.write bypasses).  BOUNDED acquire,
+            # not `with`: if the signal interrupted main() mid-print, the
+            # watchdog can be wedged inside final_once's print() waiting
+            # on the buffer lock the interrupted main thread holds — it
+            # will never release the guard, and an unbounded wait here
+            # would hang past the -k SIGKILL with no record and no exit
+            # code.  On timeout we proceed anyway: a wedged watchdog's
+            # record can never fully reach the fd, so terminating
+            # whatever partial bytes it auto-flushed and writing our own
+            # complete line is the best obtainable stdout.  (RLock: main-
+            # thread re-entry mid-emit still succeeds immediately and
+            # re-emits a complete line — the benign documented race.)
+            got = final_guard.acquire(timeout=5)
             try:
-                proc.terminate()
-            except Exception:
-                pass
-        os._exit(143)
+                # Leading newline: if the signal interrupted main()
+                # mid-print, the physical line is torn ('{...partial') —
+                # without a terminator the handler's JSON would
+                # concatenate onto it and the driver's last-line parse
+                # would see invalid JSON.  A blank line is harmless to a
+                # line-based parser.
+                os.write(1, b"\n")
+                if _PROBE_PROC is not None:
+                    attempts.append("probe still in flight at sigterm "
+                                    "(no verdict on backend state)")
+                emit = lambda: fire_final(
+                    "sigterm",
+                    f"sigterm at t+{time.time() - t_start:.0f}s: killed "
+                    "by the outer harness; lines above this one are valid "
+                    "completed measurements")
+                if got:
+                    final_once(emit)   # re-entrant acquire: instant
+                else:
+                    # Guard wedged (see above): final_once would block on
+                    # it forever.  Emit unguarded — exactly-once is moot
+                    # when the only other holder can never finish, and a
+                    # duplicate complete last line is harmless.
+                    emit()
+            finally:
+                if got:
+                    final_guard.release()
+            proc = _PROBE_PROC
+            if proc is not None:
+                # Don't orphan a probe child wedged in axon init (it
+                # would outlive us holding tunnel state).  TERM only — no
+                # time for the usual grace period under the -k window.
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+        finally:
+            os._exit(143)
 
     # signal.signal only works from the main thread; tests that call
     # main() from a worker thread just skip the handler layer.

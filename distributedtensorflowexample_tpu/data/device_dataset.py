@@ -97,7 +97,9 @@ def _try_quantize(x: np.ndarray, chunk: int = 4096):
     caller can never lose precision silently: anything not byte-exact —
     arbitrary float inputs, a future normalization this doesn't know —
     stays float32-resident."""
-    if x.dtype != np.float32 or x.ndim < 2:
+    if x.dtype != np.float32 or x.ndim < 2 or x.size == 0:
+        # Empty splits fall through to the caller's own size validation
+        # (min()/max() on a zero-length array would raise here first).
         return None
     lo, hi = float(x.min()), float(x.max())
     candidates = []

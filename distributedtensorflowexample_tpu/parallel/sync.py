@@ -326,7 +326,7 @@ def make_eval_step() -> Callable:
 
 
 def make_resident_eval(images, labels, batch_size: int = 1000,
-                       mesh=None) -> Callable:
+                       mesh=None, quantize: str = "auto") -> Callable:
     """Device-resident exact-accuracy eval: ONE dispatch per eval.
 
     The host-fed ``evaluate`` re-uploads the split 1000 rows at a time on
@@ -339,6 +339,8 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     Like the train split, a quantizable split is held as uint8 (4x less
     HBM + upload) and LUT-dequantized in the scan body — bitwise the
     same floats (see ``data.device_dataset.dequantize_images``).
+    ``quantize`` mirrors the train-path flag: ``"off"`` keeps the split
+    float32-resident (the --quantize escape hatch reaches eval too).
 
     Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
     """
@@ -347,10 +349,13 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     from distributedtensorflowexample_tpu.data.device_dataset import (
         _try_quantize, dequantize_images)
 
+    if quantize not in ("auto", "off"):
+        raise ValueError(f"unknown quantize mode {quantize!r}")
     dequant = None
-    q = _try_quantize(np.asarray(images))
-    if q is not None:
-        images, dequant = q
+    if quantize == "auto":
+        q = _try_quantize(np.asarray(images))
+        if q is not None:
+            images, dequant = q
 
     n = len(labels)
     if mesh is not None and batch_size % mesh.size:

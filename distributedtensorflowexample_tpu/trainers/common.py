@@ -174,7 +174,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         # Test split resident in HBM too: one dispatch per eval, and eval
         # wall time stops polluting the training window.
         _evaluate = make_resident_eval(test_x, test_y, batch_size=eval_batch,
-                                       mesh=mesh)
+                                       mesh=mesh, quantize=cfg.quantize)
     else:
         _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
                                       batch_size=eval_batch,

@@ -386,6 +386,18 @@ def test_non_grid_floats_stay_float_resident():
     assert np.asarray(ds.images).dtype == np.float32
 
 
+def test_empty_split_fails_with_size_message_not_reduction_error():
+    """A zero-length split must hit the 'smaller than batch' validation,
+    not a ValueError from min()/max() inside _try_quantize (ADVICE r4)."""
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        _try_quantize)
+
+    empty = np.zeros((0, 28, 28, 1), np.float32)
+    assert _try_quantize(empty) is None
+    with pytest.raises(ValueError, match="smaller than"):
+        DeviceDataset(empty, np.zeros((0,), np.int32), 64)
+
+
 def test_quantized_training_bitwise_parity():
     """12 real fused sync steps: uint8-resident and float32-resident runs
     end with BITWISE-identical parameters and loss."""

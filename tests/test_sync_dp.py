@@ -150,6 +150,30 @@ def test_resident_eval_batch_must_divide_mesh():
         make_resident_eval(x, y, batch_size=50, mesh=make_mesh())
 
 
+def test_resident_eval_quantize_off_skips_lut_path(monkeypatch):
+    """--quantize off reaches eval too (ADVICE r4): the split stays
+    float32-resident and _try_quantize is never consulted, while the
+    accuracy is identical to the quantized path (which is bitwise by
+    construction)."""
+    import distributedtensorflowexample_tpu.data.device_dataset as dd
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_resident_eval)
+
+    mesh = make_mesh()
+    state = _make_state("softmax", (64, 28, 28, 1), mesh)
+    x, y = make_synthetic(1024, (28, 28, 1), 10, seed=3)
+    want = make_resident_eval(x, y, batch_size=512, mesh=mesh)(state)
+
+    def boom(*a, **k):
+        raise AssertionError("_try_quantize consulted under quantize='off'")
+    monkeypatch.setattr(dd, "_try_quantize", boom)
+    got = make_resident_eval(x, y, batch_size=512, mesh=mesh,
+                             quantize="off")(state)
+    assert got == pytest.approx(want, abs=1e-9)
+    with pytest.raises(ValueError, match="quantize"):
+        make_resident_eval(x, y, batch_size=512, mesh=mesh, quantize="no")
+
+
 def test_partial_aggregation_uses_rotating_subset():
     """replicas_to_aggregate=R: the update at step s is driven by exactly
     the R replicas with ((i - s) mod N) < R — verified by comparing against
