@@ -91,14 +91,27 @@ class RunConfig:
                                     # EVERY mode (sync, async, augmented)
                                     # since the round-2 unfencing; "off"
                                     # selects the host Batcher+prefetch path
-    steps_per_loop: int = 1         # SGD steps fused into one compiled call
+    steps_per_loop: int = 0         # SGD steps fused into one compiled call
                                     # (lax.scan); device_data path only.
                                     # Amortizes dispatch latency like Keras
-                                    # steps_per_execution
+                                    # steps_per_execution.  0 = AUTO: the
+                                    # largest divisor of the remaining
+                                    # steps AND the log/eval/checkpoint
+                                    # intervals, <= min(64, steps_per_
+                                    # epoch) — out-of-box dispatch
+                                    # amortization with hooks still on
+                                    # their exact steps; pass 1 for one
+                                    # dispatch per step
     quantize: str = "auto"          # auto | off — hold 8-bit-exact splits
                                     # as uint8 (4x less HBM + gather/upload
                                     # bytes; in-step LUT dequant is bitwise-
                                     # identical), resident AND host paths
+    data_sharding: str = "replicated"  # replicated | sharded — sharded
+                                    # splits the resident dataset row-wise
+                                    # over the mesh (per-device HBM /
+                                    # mesh_size; per-shard shuffling like
+                                    # the reference's per-worker dataset
+                                    # sharding); device_data path only
 
     @property
     def ps_host_list(self) -> list[str]:
@@ -136,9 +149,11 @@ _FLAG_HELP = {
     "label_smoothing": "cross-entropy label smoothing",
     "seed": "global RNG seed (data order + init)",
     "data_dir": "dataset directory (IDX/.gz MNIST, pickle/binary CIFAR); "
-                "missing files fall back to a synthetic split (logged)",
+                "missing files are an error unless --dataset synthetic",
     "log_dir": "logs, scalars.jsonl, tfevents, checkpoints",
-    "dataset": "mnist | cifar10 | synthetic",
+    "dataset": "mnist | cifar10 | synthetic — synthetic is the explicit "
+               "opt-in to the deterministic synthetic split (missing real "
+               "bytes never silently substitute)",
     "eval_every": "eval every N steps (0 = only at end)",
     "log_every": "log scalars every N steps",
     "checkpoint_every": "checkpoint every N steps (0 = none periodic)",
@@ -168,12 +183,20 @@ _FLAG_HELP = {
                    "Batcher + prefetch",
     "steps_per_loop": "SGD steps fused per compiled call (lax.scan over "
                       "the device-resident dataset); like Keras "
-                      "steps_per_execution",
+                      "steps_per_execution. 0 = auto: largest divisor of "
+                      "the remaining steps and the log/eval/checkpoint "
+                      "intervals, <= min(64, steps_per_epoch); 1 = one "
+                      "dispatch per step",
     "quantize": "auto | off — store 8-bit-exact splits as uint8 in "
                 "HBM/host memory (4x less gather and upload traffic; the "
                 "in-step LUT dequantization is bitwise-identical to "
                 "float32 storage, verified at build time); off = always "
                 "float32",
+    "data_sharding": "replicated | sharded — sharded stores the resident "
+                     "split row-wise across the mesh (per-device HBM "
+                     "divided by mesh size; shuffling becomes per-shard, "
+                     "like the reference's per-worker dataset sharding); "
+                     "requires the device_data path",
 }
 
 

@@ -106,13 +106,33 @@ def _load_binary_batches(data_dir: str, split: str):
 
 def load_cifar10(data_dir: str, split: str = "train",
                  synthetic_size: int | None = None, seed: int = 0,
-                 normalize: bool = True) -> tuple[np.ndarray, np.ndarray]:
-    """Return (images [N,32,32,3] float32, labels [N] int32)."""
-    loaded = _load_binary_batches(data_dir, split)
+                 normalize: bool = True,
+                 source: str = "real") -> tuple[np.ndarray, np.ndarray]:
+    """Return (images [N,32,32,3] float32, labels [N] int32).
+
+    ``source``: ``"real"`` (default — the pickle/binary/tar batches must
+    exist, missing bytes are a crisp error naming ``--dataset synthetic``
+    as the opt-in), ``"synthetic"`` (explicit deterministic split, no
+    warning), or ``"fallback"`` (real if present else synthetic with a
+    loud warning — harness use).  See ``load_mnist``.
+    """
+    if source not in ("real", "synthetic", "fallback"):
+        raise ValueError(f"unknown source {source!r}")
+    loaded = (None if source == "synthetic"
+              else _load_binary_batches(data_dir, split))
     if loaded is None:
-        from distributedtensorflowexample_tpu.data.synthetic import (
-            warn_synthetic)
-        warn_synthetic("CIFAR-10", split, data_dir, "data_batch_*/cifar-10-*")
+        if source == "real":
+            raise FileNotFoundError(
+                f"CIFAR-10 {split!r} bytes not found in {data_dir!r} "
+                f"(expected data_batch_*/test_batch in pickle, .bin, or "
+                f"cifar-10-python.tar.gz layout). Point --data_dir at the "
+                f"batches, or pass --dataset synthetic to train on the "
+                f"deterministic synthetic split instead.")
+        if source == "fallback":
+            from distributedtensorflowexample_tpu.data.synthetic import (
+                warn_synthetic)
+            warn_synthetic("CIFAR-10", split, data_dir,
+                           "data_batch_*/cifar-10-*")
         num = synthetic_size or _SYNTH_SIZES[split]
         loaded = make_synthetic(num, (32, 32, 3), 10, seed=seed,
                                 sample_seed=seed * 2 + (1 if split == "train" else 2))

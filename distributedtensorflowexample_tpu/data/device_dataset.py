@@ -156,7 +156,8 @@ class DeviceDataset:
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  batch_size: int, mesh=None, seed: int = 0,
                  shuffle: bool = True, start_step: int = 0,
-                 steps_per_next: int = 1, quantize: str = "auto"):
+                 steps_per_next: int = 1, quantize: str = "auto",
+                 data_sharding: str = "replicated"):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
         the train step's ``unroll_steps`` so the perm ring is refreshed on
         the right call.  Any value >= 1 works; the ring is sized to hold
@@ -172,9 +173,28 @@ class DeviceDataset:
         — the float32 batches the step sees are bitwise identical either
         way.  ``"off"`` forces float storage for float input
         (``self.dequant`` is None); raw uint8 input always dequantizes
-        as u/255 ("unit")."""
+        as u/255 ("unit").
+
+        ``data_sharding="sharded"`` (VERDICT r4 #8) shards the resident
+        split ROW-WISE over the mesh's data axis instead of replicating
+        it: per-device HBM for the split drops by the mesh size, lifting
+        the per-device ceiling for datasets bigger than CIFAR.  The epoch
+        permutation is then built per device shard (device ``d`` shuffles
+        its own rows) and interleaved so the step's standard slice
+        arithmetic hands every device positions that live in ITS shard —
+        the gather stays collective-free (``sync.make_device_gather``'s
+        shard_map branch translates to local row space).  Shuffling
+        semantics become per-shard (the reference's per-worker dataset
+        sharding under MultiWorkerMirroredStrategy) rather than global;
+        rows beyond ``mesh_size * (n // mesh_size)`` are dropped.  Pass
+        the SAME mode to the step factory."""
         if quantize not in ("auto", "off"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
+        if data_sharding not in ("replicated", "sharded"):
+            raise ValueError(f"unknown data_sharding {data_sharding!r}")
+        if data_sharding == "sharded" and mesh is None:
+            raise ValueError("data_sharding='sharded' requires a mesh")
+        self.data_sharding = data_sharding
         self.dequant: str | None = None
         if images.dtype == np.uint8:
             # Raw bytes: downstream floats are u/255 by convention.
@@ -187,8 +207,31 @@ class DeviceDataset:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than "
                 f"batch {batch_size}")
+        if data_sharding == "sharded":
+            # The data-axis extent, NOT mesh.size: they agree on today's
+            # 1-D meshes, but the P(DATA_AXIS) row placement and the
+            # gather's shard count are defined by the axis — a future
+            # multi-axis mesh must not silently mis-translate indices.
+            from distributedtensorflowexample_tpu.parallel.mesh import (
+                DATA_AXIS)
+            self._D = mesh.shape[DATA_AXIS]
+        else:
+            self._D = 1
+        if data_sharding == "sharded":
+            if batch_size % self._D:
+                raise ValueError(
+                    f"sharded data: batch {batch_size} must divide across "
+                    f"{self._D} devices")
+            n_used = self._D * (len(images) // self._D)
+            images, labels = images[:n_used], labels[:n_used]
+            self._rows_per_dev = n_used // self._D
+            self._bpd = batch_size // self._D
+            # Per-shard epoch arithmetic: each device steps through ITS
+            # rows_per_dev rows in bpd-row sub-batches.
+            self.steps_per_epoch = self._rows_per_dev // self._bpd
+        else:
+            self.steps_per_epoch = len(images) // batch_size
         self._n = len(images)
-        self.steps_per_epoch = self._n // batch_size
         self.epoch_len = self.steps_per_epoch * batch_size
         if steps_per_next < 1:
             raise ValueError(
@@ -201,16 +244,32 @@ class DeviceDataset:
 
         if mesh is not None:
             from distributedtensorflowexample_tpu.parallel.mesh import (
-                replicated_sharding)
+                DATA_AXIS, replicated_sharding)
             repl = replicated_sharding(mesh)
             if jax.process_count() > 1:
                 put = lambda x: jax.make_array_from_process_local_data(repl, x)
             else:
                 put = lambda x: jax.device_put(x, repl)
+            if data_sharding == "sharded":
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rows = NamedSharding(mesh, P(DATA_AXIS))
+                if jax.process_count() > 1:
+                    # Mesh device order groups devices by process (see
+                    # put_global_batch): process p owns a contiguous row
+                    # block of the sharded split.
+                    pc, pi = jax.process_count(), jax.process_index()
+                    per = self._n // pc
+                    put_rows = lambda x: jax.make_array_from_process_local_data(
+                        rows, np.ascontiguousarray(x[pi * per:(pi + 1) * per]))
+                else:
+                    put_rows = lambda x: jax.device_put(x, rows)
+            else:
+                put_rows = put
         else:
             repl, put = None, jax.device_put
-        self.images = put(np.ascontiguousarray(images))
-        self.labels = put(np.ascontiguousarray(labels))
+            put_rows = put
+        self.images = put_rows(np.ascontiguousarray(images))
+        self.labels = put_rows(np.ascontiguousarray(labels))
         self._lut = (put(make_dequant_lut(self.dequant))
                      if self.dequant is not None else None)
 
@@ -218,6 +277,24 @@ class DeviceDataset:
 
         def make_perm(epoch: jnp.ndarray) -> jnp.ndarray:
             key = jax.random.fold_in(base, epoch)
+            if data_sharding == "sharded":
+                # Per-shard shuffle, interleaved so global positions
+                # [s*B + d*bpd, s*B + (d+1)*bpd) always hold indices from
+                # device d's row block — the step's standard slice
+                # arithmetic then never needs a cross-device gather.
+                D, L, bpd = self._D, self._rows_per_dev, self._bpd
+                keys = jax.vmap(lambda d: jax.random.fold_in(key, d))(
+                    jnp.arange(D))
+                if shuffle:
+                    local = jax.vmap(
+                        lambda k: jax.random.permutation(k, L))(keys)
+                else:
+                    local = jnp.broadcast_to(jnp.arange(L), (D, L))
+                local = local[:, :self.steps_per_epoch * bpd]
+                local = local + (jnp.arange(D) * L)[:, None]
+                order = (local.reshape(D, self.steps_per_epoch, bpd)
+                         .transpose(1, 0, 2).reshape(-1))
+                return order.astype(jnp.int32)
             if shuffle:
                 order = jax.random.permutation(key, self._n)
             else:

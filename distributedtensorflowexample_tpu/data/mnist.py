@@ -57,19 +57,40 @@ def _read_idx_labels(path: str) -> np.ndarray:
 
 def load_mnist(data_dir: str, split: str = "train",
                synthetic_size: int | None = None,
-               seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+               seed: int = 0,
+               source: str = "real") -> tuple[np.ndarray, np.ndarray]:
     """Return (images [N,28,28,1] float32 in [0,1], labels [N] int32).
 
-    Reads standard IDX(.gz) files from ``data_dir`` when present, otherwise
-    generates deterministic synthetic data of the canonical split sizes.
+    ``source`` selects where the bytes come from (VERDICT r4 #5 — no
+    silent substitution on the user surface):
+
+    - ``"real"`` (default): the standard IDX(.gz) files must exist in
+      ``data_dir``; a missing file is a crisp ``FileNotFoundError`` that
+      names ``--dataset synthetic`` as the opt-in.
+    - ``"synthetic"``: the deterministic synthetic split, explicitly
+      requested — no warning.
+    - ``"fallback"``: real if present, else synthetic with a LOUD
+      once-per-split warning (for harnesses that must run with or
+      without the bytes, e.g. bench.py on a data-less chip host).
     """
+    if source not in ("real", "synthetic", "fallback"):
+        raise ValueError(f"unknown source {source!r}")
     img_name, lbl_name = _FILES[split]
     img_path = os.path.join(data_dir, img_name)
     lbl_path = os.path.join(data_dir, lbl_name)
-    if os.path.exists(img_path) or os.path.exists(img_path + ".gz"):
+    have = os.path.exists(img_path) or os.path.exists(img_path + ".gz")
+    if source != "synthetic" and have:
         return _read_idx_images(img_path), _read_idx_labels(lbl_path)
-    from distributedtensorflowexample_tpu.data.synthetic import warn_synthetic
-    warn_synthetic("MNIST", split, data_dir, img_name)
+    if source == "real":
+        raise FileNotFoundError(
+            f"MNIST {split!r} bytes not found in {data_dir!r} (expected "
+            f"{img_name}[.gz]). Point --data_dir at the IDX files, or pass "
+            f"--dataset synthetic to train on the deterministic synthetic "
+            f"split instead.")
+    if source == "fallback":
+        from distributedtensorflowexample_tpu.data.synthetic import (
+            warn_synthetic)
+        warn_synthetic("MNIST", split, data_dir, img_name)
     num = synthetic_size or _SYNTH_SIZES[split]
     # Same class templates for both splits; disjoint sample draws — so a
     # model trained on "train" genuinely generalizes to "test".

@@ -279,7 +279,9 @@ def make_indexed_async_train_step(num_workers: int, period: int,
                                   ce_impl: str = "xla", mesh=None,
                                   unroll_steps: int = 1,
                                   augment: str = "none",
-                                  num_slots: int | None = None) -> Callable:
+                                  num_slots: int | None = None,
+                                  data_sharding: str = "replicated"
+                                  ) -> Callable:
     """Local-SGD step over a device-resident dataset — async's analog of
     ``sync.make_indexed_train_step``: same on-device gather from the
     perm ring (multi-epoch fused windows supported), same ``lax.scan``
@@ -292,7 +294,8 @@ def make_indexed_async_train_step(num_workers: int, period: int,
     inner = _build_async_step_fn(num_workers, period, label_smoothing,
                                  ce_impl, mesh)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
-                                num_slots=num_slots)
+                                num_slots=num_slots,
+                                data_sharding=data_sharding)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))

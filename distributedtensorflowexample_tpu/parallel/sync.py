@@ -76,7 +76,8 @@ def _resolve_num_slots(unroll_steps: int, steps_per_epoch: int,
 
 def make_device_gather(batch_size: int, steps_per_epoch: int,
                        augment: str = "none", mesh=None, *,
-                       num_slots: int) -> Callable:
+                       num_slots: int,
+                       data_sharding: str = "replicated") -> Callable:
     """(step, rng, data) -> batch: the on-device minibatch gather from a
     resident split (see ``data.DeviceDataset``), shared by the sync and
     async indexed step builders.  ``num_slots`` must equal the dataset's
@@ -86,9 +87,24 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
     loader's exact float32 values on the gathered batch only: the LUT
     rides in ``data["lut"]`` and the dispatch is on the resident dtype
     (static at trace time), so quantization needs NO step-factory
-    plumbing and no call site can silently train on raw bytes."""
+    plumbing and no call site can silently train on raw bytes.
+
+    ``data_sharding="sharded"`` pairs with a row-sharded
+    ``DeviceDataset(data_sharding="sharded")``: each device gathers its
+    batch shard from ITS row block under ``shard_map`` — local indices,
+    zero collectives (the dataset's interleaved per-shard permutation
+    guarantees every position a device reads lives in its block).  The
+    returned batch is sharded along the batch axis exactly like the
+    replicated gather's, so the step body downstream is unchanged."""
     if augment not in ("none", "cifar"):
         raise ValueError(f"unknown augment {augment!r}")
+    if data_sharding not in ("replicated", "sharded"):
+        raise ValueError(f"unknown data_sharding {data_sharding!r}")
+    if data_sharding == "sharded":
+        if mesh is None:
+            raise ValueError("data_sharding='sharded' requires a mesh")
+        return _make_sharded_gather(batch_size, steps_per_epoch, augment,
+                                    mesh, num_slots=num_slots)
 
     def gather(step, rng, data):
         # In-epoch position from the global step; modulo first so the
@@ -127,6 +143,60 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
             batch = jax.lax.with_sharding_constraint(batch,
                                                      batch_sharding(mesh))
         return batch
+
+    return gather
+
+
+def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
+                         augment: str, mesh, *, num_slots: int) -> Callable:
+    """The ``data_sharding="sharded"`` gather (see ``make_device_gather``):
+    runs under ``shard_map`` over the data axis, each device slicing its
+    bpd positions out of the (replicated) perm ring and translating them
+    into its local row space — index math only, no collective."""
+    from jax.sharding import PartitionSpec as P
+
+    D = mesh.shape[DATA_AXIS]
+    if batch_size % D:
+        raise ValueError(f"sharded data: batch {batch_size} must divide "
+                         f"across {D} devices")
+    bpd = batch_size // D
+
+    def gather(step, rng, data):
+        has_lut = "lut" in data
+
+        def local(step, rng, images, labels, perm, lut=None):
+            d = jax.lax.axis_index(DATA_AXIS)
+            rows = images.shape[0]              # this device's row block
+            slot = (step // steps_per_epoch) % num_slots
+            pos = (step % steps_per_epoch) * batch_size + d * bpd
+            idx = jax.lax.dynamic_slice(perm, (slot, pos), (1, bpd))[0]
+            idx = idx - d * rows                # global -> local row space
+            img = jnp.take(images, idx, axis=0)
+            if augment == "cifar":
+                # Same stream layout as the replicated gather, plus the
+                # device index: each shard draws independent crops/flips
+                # (same distribution; draws differ from replicated mode).
+                from distributedtensorflowexample_tpu.data.augment_device import (
+                    cifar_augment_device)
+                akey = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), step),
+                    d)
+                img = cifar_augment_device(img, akey)
+            if img.dtype == jnp.uint8:
+                from distributedtensorflowexample_tpu.data.device_dataset import (
+                    apply_dequant_lut)
+                img = apply_dequant_lut(img, lut)
+            return img, jnp.take(labels, idx, axis=0)
+
+        args = [step, rng, data["images"], data["labels"], data["perm"]]
+        in_specs = [P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()]
+        if has_lut:
+            args.append(data["lut"])
+            in_specs.append(P())
+        img, lab = jax.shard_map(
+            local, mesh=mesh, in_specs=tuple(in_specs),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)(*args)
+        return {"image": img, "label": lab}
 
     return gather
 
@@ -249,7 +319,8 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             unroll_steps: int = 1,
                             augment: str = "none", num_replicas: int = 1,
                             replicas_to_aggregate: int = 0,
-                            num_slots: int | None = None) -> Callable:
+                            num_slots: int | None = None,
+                            data_sharding: str = "replicated") -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -282,7 +353,8 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     inner = _build_step_fn(label_smoothing, ce_impl, mesh, num_replicas,
                            replicas_to_aggregate)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
-                                num_slots=num_slots)
+                                num_slots=num_slots,
+                                data_sharding=data_sharding)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))

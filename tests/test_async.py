@@ -90,6 +90,7 @@ def test_async_trainer_end_to_end(tmp_path, small_synthetic):
         ["--async_period", "4",
          "--train_steps", "30", "--batch_size", "8",
          "--log_dir", str(tmp_path), "--data_dir", "/nonexistent",
+         "--dataset", "synthetic",
          "--resume", "false", "--log_every", "10",
          "--learning_rate", "0.02"])
     assert summary["steps"] == 30
@@ -185,7 +186,7 @@ def test_run_training_async_device_data_steps_per_loop(tmp_path,
                   device_data="on", pallas_ce=True, train_steps=24,
                   batch_size=64, global_batch=True, learning_rate=0.3,
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
-                  dataset="mnist", log_every=8, seed=1, resume=False),
+                  dataset="synthetic", log_every=8, seed=1, resume=False),
         "softmax", "mnist")
     assert out["steps"] == 24
     assert np.isfinite(out["final_accuracy"])

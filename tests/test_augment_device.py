@@ -65,7 +65,7 @@ def test_device_resident_cifar_training(tmp_path, monkeypatch):
                         {"train": 1024, "test": 256})
     cfg = RunConfig(train_steps=8, steps_per_loop=4, batch_size=64,
                     global_batch=True, learning_rate=0.05, momentum=0.9,
-                    dataset="cifar10", data_dir=str(tmp_path),
+                    dataset="synthetic", data_dir=str(tmp_path),
                     log_dir=str(tmp_path / "logs"), resume=False,
                     log_every=4)
     out = run_training(cfg, "resnet20", "cifar10", augment=True)

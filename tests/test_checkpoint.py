@@ -263,6 +263,7 @@ def test_sync_checkpoint_flag_writes_checkpoints(tmp_path, small_synthetic):
     cfg = RunConfig(
         train_steps=4, checkpoint_every=2, resume=False,
         async_checkpoint=False, batch_size=64, global_batch=True,
+        dataset="synthetic",
         data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
         log_every=50, seed=1)
     out = run_training(cfg, "softmax", "mnist")
@@ -302,7 +303,7 @@ def test_async_worker_count_restore_is_refused(tmp_path, small_synthetic):
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
-    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+    common = dict(batch_size=64, global_batch=True, dataset="synthetic",
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
                   log_every=50, seed=1, sync_mode="async", async_period=2)
     run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,
@@ -318,7 +319,7 @@ def test_sync_mesh_size_restore_is_allowed(tmp_path, small_synthetic, capsys):
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
-    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+    common = dict(batch_size=64, global_batch=True, dataset="synthetic",
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
                   log_every=50, seed=1)
     run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,
@@ -335,7 +336,7 @@ def test_cross_mode_restore_is_refused(tmp_path, small_synthetic):
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
-    common = dict(batch_size=64, global_batch=True, dataset="mnist",
+    common = dict(batch_size=64, global_batch=True, dataset="synthetic",
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
                   log_every=50, seed=1)
     run_training(RunConfig(train_steps=4, checkpoint_every=4, resume=False,

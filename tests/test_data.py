@@ -8,7 +8,7 @@ from distributedtensorflowexample_tpu.data.cifar10 import augment
 
 
 def test_mnist_shapes_and_range(tmp_path):
-    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256)
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256, source="synthetic")
     assert x.shape == (256, 28, 28, 1)
     assert x.dtype == np.float32
     assert 0.0 <= x.min() and x.max() <= 1.0
@@ -17,20 +17,20 @@ def test_mnist_shapes_and_range(tmp_path):
 
 
 def test_mnist_deterministic(tmp_path):
-    x1, y1 = load_mnist(str(tmp_path), "train", synthetic_size=64)
-    x2, y2 = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    x1, y1 = load_mnist(str(tmp_path), "train", synthetic_size=64, source="synthetic")
+    x2, y2 = load_mnist(str(tmp_path), "train", synthetic_size=64, source="synthetic")
     np.testing.assert_array_equal(x1, x2)
     np.testing.assert_array_equal(y1, y2)
 
 
 def test_mnist_train_test_differ(tmp_path):
-    x1, _ = load_mnist(str(tmp_path), "train", synthetic_size=64)
-    x2, _ = load_mnist(str(tmp_path), "test", synthetic_size=64)
+    x1, _ = load_mnist(str(tmp_path), "train", synthetic_size=64, source="synthetic")
+    x2, _ = load_mnist(str(tmp_path), "test", synthetic_size=64, source="synthetic")
     assert not np.array_equal(x1, x2)
 
 
 def test_cifar_shapes(tmp_path):
-    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=128)
+    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=128, source="synthetic")
     assert x.shape == (128, 32, 32, 3)
     assert y.shape == (128,)
 
@@ -73,7 +73,8 @@ def test_cifar_corrupt_tar_falls_back(tmp_path, capsys):
     """A truncated/corrupt tarball (interrupted download) must behave like
     any other absent dataset — warn and fall back, not crash training."""
     (tmp_path / "cifar-10-python.tar.gz").write_bytes(b"definitely not a tar")
-    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=32)
+    x, y = load_cifar10(str(tmp_path), "train", synthetic_size=32,
+                        source="fallback")
     assert x.shape == (32, 32, 32, 3)
     # stderr, NOT stdout — bench consumers json-parse every stdout line.
     assert "ignoring unreadable" in capsys.readouterr().err
@@ -122,7 +123,7 @@ def test_batcher_auto_quantizes_and_training_is_bitwise(tmp_path):
     from distributedtensorflowexample_tpu.parallel.sync import make_train_step
     from distributedtensorflowexample_tpu.training.state import TrainState
 
-    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256)
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=256, source="synthetic")
     model = build_model("softmax")
 
     def run(quantize):
@@ -151,7 +152,7 @@ def test_batcher_uint8_augment_is_bitwise(tmp_path):
         _dequant_numpy)
 
     x, y = load_cifar10(str(tmp_path), "train", synthetic_size=128,
-                        normalize=False)
+                        normalize=False, source="synthetic")
     b_u = Batcher(x, y, 16, seed=5, augment_fn=augment)
     b_f = Batcher(x, y, 16, seed=5, augment_fn=augment, quantize="off")
     assert b_u.dequant == "unit" and b_f.dequant is None
@@ -174,7 +175,7 @@ def test_uint8_batch_without_dequant_is_a_loud_error(tmp_path):
     from distributedtensorflowexample_tpu.parallel.sync import make_train_step
     from distributedtensorflowexample_tpu.training.state import TrainState
 
-    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64, source="synthetic")
     b = Batcher(x, y, 32, seed=0)
     state = TrainState.create(build_model("softmax"), optax.sgd(0.1),
                               np.zeros((32, 28, 28, 1), np.float32))
@@ -187,7 +188,7 @@ def test_custom_float_augment_disables_quantization(tmp_path):
     """An arbitrary float-arithmetic augment hook must keep the split
     float32 (auto-quantization only engages under u8-safe rearrangement
     augments) — and a raw uint8 split is host-dequantized for it."""
-    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64)
+    x, y = load_mnist(str(tmp_path), "train", synthetic_size=64, source="synthetic")
     noisy = lambda im, rng: im + rng.normal(0, 0.1, im.shape).astype(im.dtype)
     b = Batcher(x, y, 32, seed=0, augment_fn=noisy)
     assert b.dequant is None

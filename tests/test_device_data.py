@@ -162,7 +162,7 @@ def test_run_training_device_data_end_to_end(tmp_path, small_synthetic):
 
     common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
-                  dataset="mnist", log_every=50, seed=1, steps_per_loop=10)
+                  dataset="synthetic", log_every=50, seed=1, steps_per_loop=10)
     out = run_training(RunConfig(train_steps=60, checkpoint_every=50,
                                  resume=False, **common), "softmax", "mnist")
     assert out["steps"] == 60
@@ -205,7 +205,7 @@ def test_run_training_steps_per_loop(tmp_path, small_synthetic):
 
     common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
                   data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
-                  dataset="mnist", log_every=20, seed=1, resume=False)
+                  dataset="synthetic", log_every=20, seed=1, resume=False)
     out = run_training(RunConfig(train_steps=60, steps_per_loop=4, **common),
                        "softmax", "mnist")
     assert out["steps"] == 60
@@ -213,6 +213,59 @@ def test_run_training_steps_per_loop(tmp_path, small_synthetic):
     with pytest.raises(ValueError, match="multiple"):
         run_training(RunConfig(train_steps=61, steps_per_loop=4, **common),
                      "softmax", "mnist")
+
+
+def test_auto_steps_per_loop_value():
+    """--steps_per_loop 0 picks the largest divisor of the remaining steps
+    bounded by the cap and the epoch length (VERDICT r4 #4)."""
+    from distributedtensorflowexample_tpu.trainers.common import (
+        auto_steps_per_loop)
+
+    assert auto_steps_per_loop(60, 32) == 30       # <= min(64, 32, 60)
+    assert auto_steps_per_loop(64, 100) == 64      # cap itself divides
+    assert auto_steps_per_loop(61, 100) == 61      # remaining <= cap
+    assert auto_steps_per_loop(122, 100) == 61     # largest divisor <= 64
+    assert auto_steps_per_loop(127, 100) == 1      # prime > cap
+    assert auto_steps_per_loop(1, 32) == 1
+    assert auto_steps_per_loop(40, 8) == 8         # epoch length caps
+    assert auto_steps_per_loop(1000, 8, cap=64) == 8
+    # Periodic hooks constrain the unroll: it must divide every positive
+    # interval so eval/checkpoint/log marks land on exact steps.
+    assert auto_steps_per_loop(40, 64, intervals=(100, 20, 0)) == 20
+    assert auto_steps_per_loop(4, 32, intervals=(50, 0, 2)) == 2
+    assert auto_steps_per_loop(60, 32, intervals=(1,)) == 1   # per-step logs
+    assert auto_steps_per_loop(1000, 937, intervals=(100,)) == 50
+    # Resume offset: boundaries are start + k*d, so d must divide the
+    # start too or interval marks drift (e.g. fire at 73/83/93 not
+    # 70/80/90 after resuming from an odd step).
+    assert auto_steps_per_loop(30, 100, intervals=(10,), start=60) == 10
+    assert auto_steps_per_loop(30, 100, intervals=(10,), start=63) == 1
+    assert auto_steps_per_loop(20, 32, start=60) == 20
+    # Always a divisor: the default CLI can never hit the multiple error.
+    for remaining in range(1, 200):
+        for spe in (1, 7, 32):
+            assert remaining % auto_steps_per_loop(remaining, spe) == 0
+            assert remaining % auto_steps_per_loop(
+                remaining, spe, intervals=(20, 7)) == 0
+
+
+def test_run_training_auto_unroll_default(tmp_path, small_synthetic):
+    """The shipped default (steps_per_loop=0 -> auto): exact target step
+    count, hooks/logs at the fused boundaries, and a resume whose new
+    remaining count re-picks a valid divisor."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  dataset="synthetic", log_every=20, seed=1)
+    out = run_training(RunConfig(train_steps=60, checkpoint_every=50,
+                                 resume=False, **common), "softmax", "mnist")
+    assert out["steps"] == 60          # auto unroll divides 60 exactly
+    assert out["final_accuracy"] > 0.8
+    out2 = run_training(RunConfig(train_steps=80, resume=True, **common),
+                        "softmax", "mnist")
+    assert out2["steps"] == 80         # remaining 20 re-picked cleanly
 
 
 def test_unrolled_step_across_epoch_boundary_matches_stepwise():
@@ -384,6 +437,155 @@ def test_non_grid_floats_stay_float_resident():
                        mesh=make_mesh())
     assert ds.dequant is None
     assert np.asarray(ds.images).dtype == np.float32
+
+
+# ---- sharded-resident split (round 5, VERDICT r4 #8) --------------------
+# data_sharding="sharded": the split is stored row-wise across the mesh
+# (1/D of the HBM per device); the interleaved per-shard permutation keeps
+# the gather collective-free.
+
+
+def test_sharded_perm_positions_stay_in_shard_blocks():
+    """Every position device d reads (batch columns [d*bpd,(d+1)*bpd) of
+    each step) must name a row in d's block — the invariant that makes the
+    local-index gather correct with zero collectives."""
+    mesh = make_mesh()
+    D = mesh.size
+    x, y = _data(520)                      # truncates to 520, L=65/device
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=3, data_sharding="sharded")
+    L, bpd = 520 // D, 64 // D
+    assert ds.steps_per_epoch == L // bpd
+    perm = np.asarray(next(ds)["perm"])
+    for row in perm[:2]:                   # epochs 0, 1 resident
+        grid = row.reshape(ds.steps_per_epoch, D, bpd)
+        for d in range(D):
+            block = grid[:, d, :].ravel()
+            assert block.min() >= d * L and block.max() < (d + 1) * L
+            # Per-shard epochs are without replacement too.
+            assert len(np.unique(block)) == block.size
+
+
+def test_sharded_gather_matches_host_rows():
+    """The shard_map gather returns exactly the rows the interleaved perm
+    names — bitwise, including the uint8->LUT dequantization."""
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_device_gather)
+
+    mesh = make_mesh()
+    x, y = _data(512)
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=4, data_sharding="sharded")
+    assert ds.dequant == "unit"            # synthetic snaps to 8-bit grid
+    gather = make_device_gather(64, ds.steps_per_epoch, mesh=mesh,
+                                num_slots=ds.num_slots,
+                                data_sharding="sharded")
+    g = jax.jit(lambda s, data: gather(s, jax.random.PRNGKey(0), data))
+    with mesh:
+        for step in (0, 3, ds.steps_per_epoch - 1):
+            data = ds.peek()
+            perm = np.asarray(data["perm"])
+            idx = perm[0, step * 64:(step + 1) * 64]    # epoch 0 -> slot 0
+            batch = g(jnp.asarray(step, jnp.int32), data)
+            np.testing.assert_array_equal(np.asarray(batch["image"]), x[idx])
+            np.testing.assert_array_equal(np.asarray(batch["label"]), y[idx])
+
+
+def test_sharded_training_matches_host_fed_bitwise():
+    """10 steps on the sharded-resident path == 10 steps of the plain
+    host-fed step on the identical rows, bit-for-bit on params."""
+    from distributedtensorflowexample_tpu.data.pipeline import (
+        put_global_batch)
+    from distributedtensorflowexample_tpu.parallel.mesh import batch_sharding
+
+    mesh = make_mesh()
+    x, y = _data(512)
+    b, steps = 64, 10
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=2, data_sharding="sharded")
+    make_state = lambda: TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.2), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    s_sh, s_ref = make_state(), make_state()
+    step_sh = make_indexed_train_step(b, ds.steps_per_epoch, mesh=mesh,
+                                      num_slots=ds.num_slots,
+                                      data_sharding="sharded")
+    step_ref = make_train_step(mesh=mesh)
+    shard = batch_sharding(mesh)
+    with mesh:
+        for i in range(steps):
+            data = next(ds)
+            perm = np.asarray(data["perm"])
+            spe, S = ds.steps_per_epoch, ds.num_slots
+            idx = perm[(i // spe) % S, (i % spe) * b:(i % spe) * b + b]
+            s_sh, m_sh = step_sh(s_sh, data)
+            host = put_global_batch({"image": x[idx], "label": y[idx]},
+                                    shard)
+            s_ref, m_ref = step_ref(s_ref, host)
+    assert int(s_sh.step) == int(s_ref.step) == steps
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c),
+                 s_sh.params, s_ref.params)
+
+
+def test_sharded_dataset_reduces_per_device_bytes():
+    """The whole point: per-device HBM for the split is 1/D of the
+    replicated footprint (same totals, same dtype)."""
+    mesh = make_mesh()
+    D = mesh.size
+    x, y = _data(512)
+    ds_r = DeviceDataset(x, y, 64, mesh=mesh, seed=0)
+    ds_s = DeviceDataset(x, y, 64, mesh=mesh, seed=0,
+                         data_sharding="sharded")
+    rb = ds_r.images.addressable_shards[0].data.nbytes
+    sb = ds_s.images.addressable_shards[0].data.nbytes
+    assert sb * D == rb
+    assert len({s.data.nbytes for s in ds_s.images.addressable_shards}) == 1
+
+
+def test_sharded_async_composes():
+    """Sharded-resident gather under the async local-SGD shard_map step:
+    workers still diverge and reconcile; the device-local batch shard is
+    exactly its worker's rows."""
+    from distributedtensorflowexample_tpu.parallel.async_ps import (
+        make_indexed_async_train_step, make_worker_state)
+
+    mesh = make_mesh()
+    x, y = _data(512)
+    b = 64
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=5, steps_per_next=4,
+                       data_sharding="sharded")
+    state = TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    state = make_worker_state(state, mesh.size, mesh)
+    step = make_indexed_async_train_step(
+        mesh.size, 8, b, ds.steps_per_epoch, mesh=mesh, unroll_steps=4,
+        num_slots=ds.num_slots, data_sharding="sharded")
+    with mesh:
+        state, m = step(state, next(ds))      # step 4: mid-period
+        leaf = np.asarray(jax.tree.leaves(state.params)[0])
+        assert not np.array_equal(leaf[0], leaf[1])   # diverged
+        state, m = step(state, next(ds))      # step 8: averaging point
+        leaf = np.asarray(jax.tree.leaves(state.params)[0])
+        np.testing.assert_allclose(leaf[0], leaf[-1], rtol=1e-6, atol=1e-7)
+    assert int(state.step) == 8
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_run_training_sharded_end_to_end(tmp_path, small_synthetic):
+    """--data_sharding sharded through the full trainer path (auto unroll,
+    eval, exact step count) + the device_data=off incompatibility error."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.trainers.common import run_training
+
+    common = dict(batch_size=64, global_batch=True, learning_rate=0.5,
+                  data_dir=str(tmp_path), log_dir=str(tmp_path / "logs"),
+                  dataset="synthetic", log_every=20, seed=1, resume=False)
+    out = run_training(RunConfig(train_steps=60, data_sharding="sharded",
+                                 **common), "softmax", "mnist")
+    assert out["steps"] == 60
+    assert out["final_accuracy"] > 0.8
+    with pytest.raises(ValueError, match="data_sharding"):
+        run_training(RunConfig(train_steps=60, data_sharding="sharded",
+                               device_data="off", **common),
+                     "softmax", "mnist")
 
 
 def test_empty_split_fails_with_size_message_not_reduction_error():

@@ -64,7 +64,8 @@ cifar10._SYNTH_SIZES = {{"train": 512, "test": 256}}
 from distributedtensorflowexample_tpu.trainers import trainer_multiworker_cifar
 s = trainer_multiworker_cifar.main([
     "--train_steps", "4", "--batch_size", "4", "--log_dir", {logdir!r},
-    "--data_dir", "/nonexistent", "--resume", "false", "--log_every", "2",
+    "--data_dir", "/nonexistent", "--dataset", "synthetic",
+    "--resume", "false", "--log_every", "2",
 ])
 print("SUMMARY steps=%d replicas=%d acc=%.4f"
       % (s["steps"], s["num_replicas"], s["final_accuracy"]))
@@ -97,6 +98,7 @@ s = trainer_ps_mnist.main([
     "--train_steps", "8", "--batch_size", "8", "--global_batch", "true",
     "--steps_per_loop", "2", "--async_period", "4",
     "--log_dir", {logdir!r}, "--data_dir", "/nonexistent",
+    "--dataset", "synthetic",
     "--resume", "false", "--log_every", "4", "--learning_rate", "0.05",
 ])
 print("SUMMARY steps=%d replicas=%d acc=%.4f"
@@ -135,7 +137,7 @@ from distributedtensorflowexample_tpu.parallel.sync import (
 from distributedtensorflowexample_tpu.training.state import TrainState
 mesh = make_mesh()
 assert mesh.size == 2 and jax.process_count() == 2
-x, y = load_mnist("/nonexistent", "test")
+x, y = load_mnist("/nonexistent", "test", source="synthetic")
 state = TrainState.create_sharded(build_model("softmax"), optax.sgd(0.1),
                                   (64, 28, 28, 1), 3,
                                   replicated_sharding(mesh))
@@ -174,7 +176,8 @@ mnist._SYNTH_SIZES = {{"train": 256, "test": 128}}
 from distributedtensorflowexample_tpu.trainers import (
     trainer_ps_mnist, trainer_sync_mnist)
 common = ["--train_steps", "4", "--batch_size", "8", "--global_batch",
-          "true", "--data_dir", "/nonexistent", "--resume", "false",
+          "true", "--data_dir", "/nonexistent", "--dataset", "synthetic",
+          "--resume", "false",
           "--log_every", "2", "--learning_rate", "0.05"]
 s = trainer_sync_mnist.main(
     common + ["--steps_per_loop", "2", "--log_dir", {logdir!r} + "/sync"])
@@ -242,7 +245,7 @@ for shard in arr.addressable_shards:
     np.testing.assert_array_equal(np.asarray(shard.data), x[shard.index])
 print("PUT_GLOBAL_OK")
 
-xs, ys = load_mnist("/nonexistent", "test")
+xs, ys = load_mnist("/nonexistent", "test", source="synthetic")
 state = TrainState.create_sharded(build_model("softmax"), optax.sgd(0.1),
                                   (64, 28, 28, 1), 3,
                                   replicated_sharding(mesh))

@@ -188,7 +188,7 @@ def test_fused_optimizer_rejected_in_async_mode(tmp_path):
 
     cfg = RunConfig(sync_mode="async", fused_optimizer=True, momentum=0.9,
                     train_steps=1, batch_size=64, global_batch=True,
-                    dataset="mnist", data_dir=str(tmp_path),
+                    dataset="synthetic", data_dir=str(tmp_path),
                     log_dir=str(tmp_path / "logs"), resume=False)
     with pytest.raises(ValueError, match="fused_optimizer"):
         run_training(cfg, "softmax", "mnist")

@@ -104,9 +104,12 @@ def test_synthetic_fallback_warns_once(tmp_path, capfd):
     from distributedtensorflowexample_tpu.data.mnist import load_mnist
 
     synthetic._warned.clear()
-    load_mnist(str(tmp_path), "train", synthetic_size=64)
-    load_mnist(str(tmp_path), "train", synthetic_size=64)   # deduped
-    load_mnist(str(tmp_path), "test", synthetic_size=64)    # new split
+    load_mnist(str(tmp_path), "train", synthetic_size=64,
+               source="fallback")
+    load_mnist(str(tmp_path), "train", synthetic_size=64,   # deduped
+               source="fallback")
+    load_mnist(str(tmp_path), "test", synthetic_size=64,    # new split
+               source="fallback")
     err = capfd.readouterr().err
     assert err.count("DETERMINISTIC SYNTHETIC") == 2
     assert "MNIST 'train' bytes not found" in err
@@ -119,5 +122,6 @@ def test_synthetic_fallback_warning_suppressible(tmp_path, capfd,
 
     synthetic._warned.clear()
     monkeypatch.setenv("DISTTF_TPU_QUIET_SYNTHETIC", "1")
-    load_cifar10(str(tmp_path), "train", synthetic_size=64)
+    load_cifar10(str(tmp_path), "train", synthetic_size=64,
+                 source="fallback")
     assert "SYNTHETIC" not in capfd.readouterr().err

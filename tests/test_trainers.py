@@ -12,6 +12,7 @@ from distributedtensorflowexample_tpu.trainers import (
 
 def _common_flags(tmp_log_dir, extra=()):
     return ["--log_dir", tmp_log_dir, "--data_dir", "/nonexistent",
+            "--dataset", "synthetic",   # explicit opt-in: no real bytes here
             "--resume", "false", "--log_every", "20", *extra]
 
 
@@ -45,6 +46,24 @@ def test_eval_every_writes_scalars(tmp_log_dir, small_synthetic):
     evals = [s for s in scalars if "eval_accuracy" in s]
     assert [s["step"] for s in evals] == [20, 40]
     assert all(0.0 <= s["eval_accuracy"] <= 1.0 for s in evals)
+
+
+def test_missing_real_data_is_a_crisp_error(tmp_log_dir):
+    """Without --dataset synthetic, an empty --data_dir must fail by name
+    (VERDICT r4 #5) — never silently train on substituted data."""
+    with pytest.raises(FileNotFoundError, match="--dataset synthetic"):
+        trainer_local_mnist.main(
+            ["--log_dir", tmp_log_dir, "--data_dir", "/nonexistent",
+             "--resume", "false", "--train_steps", "1"])
+
+
+def test_dataset_trainer_mismatch_is_an_error(tmp_log_dir):
+    """--dataset cifar10 on an MNIST trainer is a config error, caught
+    before any data is read."""
+    with pytest.raises(ValueError, match="does not match"):
+        trainer_local_mnist.main(
+            ["--log_dir", tmp_log_dir, "--dataset", "cifar10",
+             "--resume", "false", "--train_steps", "1"])
 
 
 def test_ps_role_exits_with_notice(tmp_log_dir, capsys):
