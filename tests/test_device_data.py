@@ -524,6 +524,40 @@ def test_sharded_training_matches_host_fed_bitwise():
                  s_sh.params, s_ref.params)
 
 
+def test_sharded_gather_with_device_augment():
+    """The sharded gather's CIFAR augment branch: labels are the exact
+    perm rows (augment never touches them), images keep shape/dtype and
+    are a crop/flip rearrangement of the named rows (uint8-resident:
+    every output pixel exists in the source row's padded reflection),
+    and draws are deterministic per (rng, step)."""
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_device_gather)
+
+    mesh = make_mesh()
+    x, y = _data(512, shape=(32, 32, 3))
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=6, data_sharding="sharded")
+    gather = make_device_gather(64, ds.steps_per_epoch, augment="cifar",
+                                mesh=mesh, num_slots=ds.num_slots,
+                                data_sharding="sharded")
+    g = jax.jit(lambda s, r, data: gather(s, r, data))
+    rng = jax.random.PRNGKey(1)
+    with mesh:
+        data = ds.peek()
+        perm = np.asarray(data["perm"])
+        idx = perm[0, :64]
+        b1 = g(jnp.asarray(0, jnp.int32), rng, data)
+        b2 = g(jnp.asarray(0, jnp.int32), rng, data)
+    np.testing.assert_array_equal(np.asarray(b1["label"]), y[idx])
+    assert b1["image"].shape == (64, 32, 32, 3)
+    assert b1["image"].dtype == jnp.float32          # dequantized
+    # Deterministic per (rng, step); crop/flip only rearranges pixels, so
+    # every augmented pixel value already exists in its source row.
+    np.testing.assert_array_equal(np.asarray(b1["image"]),
+                                  np.asarray(b2["image"]))
+    for row, src in zip(np.asarray(b1["image"])[:8], x[idx[:8]]):
+        assert set(np.unique(row)) <= set(np.unique(src))
+
+
 def test_sharded_dataset_reduces_per_device_bytes():
     """The whole point: per-device HBM for the split is 1/D of the
     replicated footprint (same totals, same dtype)."""

@@ -192,18 +192,25 @@ s = trainer_ps_mnist.main(
               "--log_dir", {logdir!r} + "/async"])
 print("ASYNC steps=%d replicas=%d acc=%.6f"
       % (s["steps"], s["num_replicas"], s["final_accuracy"]))
+s = trainer_sync_mnist.main(
+    common + ["--steps_per_loop", "2", "--data_sharding", "sharded",
+              "--log_dir", {logdir!r} + "/shard"])
+print("SHARDED steps=%d replicas=%d acc=%.6f"
+      % (s["steps"], s["num_replicas"], s["final_accuracy"]))
 """
 
 
 def test_nxm_training_all_modes(tmp_path):
     """2 procs x 4 devices: sync device-resident, sync host-fed
-    (Batcher + put_local_batch), and async local-SGD (8 worker tiles
-    spanning 2 processes) all train and agree bitwise across processes."""
-    # 3 trainings x several compiles per worker: give the launch the time
-    # budget of three ordinary multihost tests.
+    (Batcher + put_local_batch), async local-SGD (8 worker tiles
+    spanning 2 processes), and sharded-resident (each process uploads
+    only ITS devices' row blocks) all train and agree bitwise across
+    processes."""
+    # 4 trainings x several compiles per worker: give the launch the time
+    # budget of four ordinary multihost tests (was 840 for three).
     outputs = _run_two_workers(_NXM_TRAIN_SCRIPT, tmp_path,
-                               devices_per_proc=4, timeout=840)
-    for tag in ("SYNC", "HOSTFED", "ASYNC"):
+                               devices_per_proc=4, timeout=1120)
+    for tag in ("SYNC", "HOSTFED", "ASYNC", "SHARDED"):
         lines = [l for out in outputs for l in out.splitlines()
                  if l.startswith(tag + " ")]
         assert len(lines) == 2, outputs
