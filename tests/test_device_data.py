@@ -46,20 +46,26 @@ def test_epoch_is_permutation_without_replacement():
     assert len(np.unique(ring2[2])) == ds.epoch_len    # epoch 2 prefetched
 
 
-def test_start_step_alignment_matches_fresh_run():
+@pytest.mark.parametrize("data_sharding", ["replicated", "sharded"])
+def test_start_step_alignment_matches_fresh_run(data_sharding):
     """A dataset started at step k yields the same perm schedule a fresh
-    dataset reaches after k nexts — resume determinism.  Only the rows the
-    step can read (current epoch + prefetch) are compared: a resumed ring
-    doesn't backfill slots of epochs that already passed."""
+    dataset reaches after k nexts — resume determinism, in both storage
+    layouts (sharded: the per-shard epoch streams are deterministic
+    functions of (seed, epoch, device)).  Only the rows the step can read
+    (current epoch + prefetch) are compared: a resumed ring doesn't
+    backfill slots of epochs that already passed."""
     x, y = _data()
     mesh = make_mesh()
     k = 11
-    fresh = DeviceDataset(x, y, 64, mesh=mesh, seed=5)
+    mk = lambda **kw: DeviceDataset(x, y, 64, mesh=mesh, seed=5,
+                                    data_sharding=data_sharding, **kw)
+    fresh = mk()
     for _ in range(k):
         next(fresh)
-    resumed = DeviceDataset(x, y, 64, mesh=mesh, seed=5, start_step=k)
+    resumed = mk(start_step=k)
     assert fresh.num_slots == resumed.num_slots
     spe, S = fresh.steps_per_epoch, fresh.num_slots
+    assert spe == resumed.steps_per_epoch
     for i in range(5):
         rf = np.asarray(next(fresh)["perm"])
         rr = np.asarray(next(resumed)["perm"])
@@ -536,6 +542,7 @@ def test_sharded_gather_with_device_augment():
     mesh = make_mesh()
     x, y = _data(512, shape=(32, 32, 3))
     ds = DeviceDataset(x, y, 64, mesh=mesh, seed=6, data_sharding="sharded")
+    assert ds.dequant == "unit"      # uint8-resident: LUT branch is live
     gather = make_device_gather(64, ds.steps_per_epoch, augment="cifar",
                                 mesh=mesh, num_slots=ds.num_slots,
                                 data_sharding="sharded")
@@ -571,6 +578,40 @@ def test_sharded_dataset_reduces_per_device_bytes():
     sb = ds_s.images.addressable_shards[0].data.nbytes
     assert sb * D == rb
     assert len({s.data.nbytes for s in ds_s.images.addressable_shards}) == 1
+
+
+def test_sharded_flag_validation_and_quantize_off():
+    """Bad batch/mesh combinations fail by name; quantize='off' keeps the
+    sharded split float32 and training still runs."""
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_device_gather)
+
+    mesh = make_mesh()
+    x, y = _data(512)
+    with pytest.raises(ValueError, match="divide"):
+        DeviceDataset(x, y, mesh.size + 1, mesh=mesh,
+                      data_sharding="sharded")
+    with pytest.raises(ValueError, match="mesh"):
+        DeviceDataset(x, y, 64, data_sharding="sharded")   # no mesh
+    with pytest.raises(ValueError, match="data_sharding"):
+        DeviceDataset(x, y, 64, mesh=mesh, data_sharding="bogus")
+    with pytest.raises(ValueError, match="divide"):
+        make_device_gather(mesh.size + 1, 4, mesh=mesh, num_slots=3,
+                           data_sharding="sharded")
+
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=1, data_sharding="sharded",
+                       quantize="off")
+    assert ds.dequant is None
+    assert np.asarray(ds.images).dtype == np.float32
+    step = make_indexed_train_step(64, ds.steps_per_epoch, mesh=mesh,
+                                   num_slots=ds.num_slots,
+                                   data_sharding="sharded")
+    state = TrainState.create_sharded(
+        build_model("softmax"), optax.sgd(0.1), (64, 28, 28, 1), 0,
+        replicated_sharding(mesh))
+    with mesh:
+        state, m = step(state, next(ds))
+    assert np.isfinite(float(m["loss"]))
 
 
 def test_sharded_async_composes():
