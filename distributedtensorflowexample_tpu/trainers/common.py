@@ -255,6 +255,12 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                            cfg.checkpoint_every),
                 start=int(state.step))
                 if remaining > 0 else 1)
+            if steps_per_call > 1 and is_chief:
+                # Say what the default chose: the user sees logs arrive
+                # in strides and should know why (and how to opt out).
+                print(f"steps_per_loop auto: fusing {steps_per_call} "
+                      f"steps per dispatch (--steps_per_loop 1 for "
+                      f"per-step dispatch)", flush=True)
         else:
             steps_per_call = max(1, cfg.steps_per_loop)
             if remaining > 0 and remaining % steps_per_call:

@@ -255,10 +255,12 @@ def test_auto_steps_per_loop_value():
                 remaining, spe, intervals=(20, 7)) == 0
 
 
-def test_run_training_auto_unroll_default(tmp_path, small_synthetic):
+def test_run_training_auto_unroll_default(tmp_path, small_synthetic,
+                                          capsys):
     """The shipped default (steps_per_loop=0 -> auto): exact target step
-    count, hooks/logs at the fused boundaries, and a resume whose new
-    remaining count re-picks a valid divisor."""
+    count, hooks/logs at the fused boundaries, a chief notice naming the
+    chosen unroll, and a resume whose new remaining count re-picks a
+    valid divisor."""
     from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.trainers.common import run_training
 
@@ -269,6 +271,7 @@ def test_run_training_auto_unroll_default(tmp_path, small_synthetic):
                                  resume=False, **common), "softmax", "mnist")
     assert out["steps"] == 60          # auto unroll divides 60 exactly
     assert out["final_accuracy"] > 0.8
+    assert "steps_per_loop auto: fusing" in capsys.readouterr().out
     out2 = run_training(RunConfig(train_steps=80, resume=True, **common),
                         "softmax", "mnist")
     assert out2["steps"] == 80         # remaining 20 re-picked cleanly
