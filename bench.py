@@ -613,7 +613,11 @@ def main() -> None:
             os._exit(143)
 
     # signal.signal only works from the main thread; tests that call
-    # main() from a worker thread just skip the handler layer.
+    # main() from a worker thread just skip the handler layer.  This is
+    # deliberately NOT utils.signals.installed_signal_handler: importing
+    # ANY package module pulls in jax, and the whole point of the block
+    # below is that the handler is live BEFORE the first package import.
+    # Keep the restore semantics in sync with that helper.
     install = threading.current_thread() is threading.main_thread()
     prev_term = signal.signal(signal.SIGTERM, on_sigterm) if install else None
     try:
@@ -626,8 +630,12 @@ def main() -> None:
     finally:
         # Restore so one main() call inside a larger process (pytest)
         # doesn't permanently hijack that process's SIGTERM semantics.
+        # A non-Python-installed previous handler reads back as None,
+        # which signal.signal refuses — restore SIG_DFL then.
         if install:
-            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGTERM,
+                          prev_term if prev_term is not None
+                          else signal.SIG_DFL)
 
 
 def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,

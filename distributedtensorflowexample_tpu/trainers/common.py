@@ -44,6 +44,12 @@ _SAMPLE_SHAPES = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
 # much larger sweeps (unroll in the thousands) stay a bench concern.
 _AUTO_UNROLL_CAP = 64
 
+# Multi-host preemption consensus cadence in GLOBAL steps: how stale the
+# unanimous-stop decision may be.  Tens of steps of detection latency is
+# negligible against a preemption grace period, and polling every
+# boundary at unroll 1 would add a cross-host sync to every step.
+_CONSENSUS_POLL_STEPS = 64
+
 
 def auto_steps_per_loop(remaining: int, steps_per_epoch: int,
                         cap: int = _AUTO_UNROLL_CAP,
@@ -140,6 +146,45 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     cluster.maybe_initialize_distributed(info)
 
     mesh = make_mesh(cfg.num_devices)
+    if jax.process_count() > 1:
+        # Every later decision with a collective in it — loop length,
+        # unroll, eval/checkpoint cadence, the SHARED checkpoint
+        # directory (divergent paths split-brain Orbax's collective-save
+        # barriers and WEDGE the first save — observed), the stop
+        # consensus — assumes the processes were launched with the same
+        # flags.  Verify once, up front, unconditionally (a guard gated
+        # on per-process config would itself be a mismatched
+        # collective), and fail by name instead of hanging later.
+        # Per-process-legitimate fields (cluster identity, local data /
+        # profile paths) are excluded.
+        import dataclasses
+        import zlib
+
+        from jax.experimental import multihost_utils
+        per_process = {"job_name", "task_index", "process_id", "ps_hosts",
+                       "worker_hosts", "coordinator_address",
+                       "num_processes", "data_dir", "profile_dir"}
+        if not (cfg.checkpoint_every > 0 or cfg.resume):
+            # Without checkpointing there is no collective touching the
+            # path — per-worker scratch log dirs are legitimate (the
+            # reference's workers logged locally).  Enablement itself is
+            # in the digest, so divergent enablement still errors.
+            per_process = per_process | {"log_dir"}
+        blob = repr(sorted(
+            (k, v) for k, v in dataclasses.asdict(cfg).items()
+            if k not in per_process)).encode()
+        digests = multihost_utils.process_allgather(
+            np.uint32(zlib.crc32(blob)))
+        if len({int(d) for d in digests}) > 1:
+            raise ValueError(
+                f"run configuration differs across the "
+                f"{jax.process_count()} processes (config digests "
+                f"{sorted({int(d) for d in digests})}). Collective "
+                "decisions (train_steps, steps_per_loop, eval/checkpoint "
+                "cadence, the shared --log_dir) must agree on every "
+                "process — launch all workers with identical flags "
+                "(only cluster identity, --data_dir and --profile_dir "
+                "may differ)")
     num_replicas = mesh.size
     global_batch = cfg.batch_size if cfg.global_batch else cfg.batch_size * num_replicas
     if global_batch % num_replicas:
@@ -307,11 +352,95 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                                      mesh=mesh, num_replicas=num_replicas,
                                      replicas_to_aggregate=cfg.replicas_to_aggregate,
                                      dequant=batcher.dequant)
-    with mesh:
-        loop = TrainLoop(train_step, batches, cfg.train_steps, hooks, logger,
-                         steps_per_call=steps_per_call)
-        state = loop.run(state)
-        final_acc = eval_fn(state)
+    # Preemption safety (TPU-first failure recovery, SURVEY §5): the
+    # platform sends SIGTERM before reclaiming a slice/VM.  The handler
+    # only SETS A FLAG — the loop polls it at call boundaries and stops
+    # cleanly (end hooks run, final checkpoint written), then the
+    # process exits 143 so a restarted job auto-resumes (--resume
+    # default) from the last completed step.  Raising from the handler
+    # instead is unsafe: the step donates its input state, and an
+    # exception landing mid-call leaves deleted buffers (see TrainLoop).
+    import signal
+
+    from distributedtensorflowexample_tpu.utils.signals import (
+        installed_signal_handler)
+
+    sigterm_seen = []
+    stop_agreed = []
+
+    def _on_term(signum, frame):
+        sigterm_seen.append(True)
+
+    if jax.process_count() > 1:
+        # Multi-host: the stop decision must be UNANIMOUS at the SAME
+        # call boundary — a lone process breaking out would leave the
+        # others blocked in the next step's gradient psum until the
+        # SIGKILL, and the collective Orbax save requires every process
+        # to call it with the same step.  process_allgather at a
+        # boundary is itself a collective all processes reach in
+        # lockstep.  Polled roughly every _CONSENSUS_POLL_STEPS global
+        # steps (every boundary for fused windows that big): a per-call
+        # cross-host sync at unroll 1 would tax every step to detect a
+        # rare event, and tens of steps of detection latency is nothing
+        # against a preemption grace period.
+        from jax.experimental import multihost_utils
+
+        poll_every = max(1, _CONSENSUS_POLL_STEPS // steps_per_call)
+        boundary = [0]
+
+        def _consensus():
+            agreed = bool(multihost_utils.process_allgather(
+                np.int32(bool(sigterm_seen))).max())
+            if agreed:
+                stop_agreed.append(True)
+            return agreed
+
+        def _should_stop():
+            i = boundary[0]
+            boundary[0] += 1
+            if i % poll_every:
+                return False        # uniform skip: same count everywhere
+            return _consensus()
+    else:
+        def _consensus():
+            if sigterm_seen:
+                stop_agreed.append(True)
+            return bool(sigterm_seen)
+
+        _should_stop = _consensus
+
+    with installed_signal_handler(signal.SIGTERM, _on_term):
+        with mesh:
+            loop = TrainLoop(train_step, batches, cfg.train_steps, hooks,
+                             logger, steps_per_call=steps_per_call,
+                             should_stop=_should_stop)
+            state = loop.run(state)
+            if not stop_agreed:
+                # One more uniform consensus poll (every process reaches
+                # this point in lockstep): a signal that landed after
+                # the last boundary poll — or during the loop's final
+                # steps — still saves BEFORE the final eval spends grace
+                # time.  A signal landing inside the eval dispatch
+                # itself remains unhonorable mid-collective.
+                _consensus()
+            if stop_agreed:
+                # End hooks already force-saved (CheckpointHook.end); a
+                # manager without the periodic hook (resume-only run)
+                # still gets the final save.  Skip the final eval — the
+                # slice is being reclaimed.
+                if manager is not None and cfg.checkpoint_every == 0:
+                    manager.save(int(state.step), state, force=True)
+                    manager.wait()
+                if is_chief:
+                    saved = ("checkpoint saved, restart auto-resumes"
+                             if manager is not None else
+                             "NO checkpoint manager (--checkpoint_every 0 "
+                             "--resume false) — NOTHING SAVED")
+                    print(f"SIGTERM at step {int(state.step)}: {saved}; "
+                          f"exiting 143", flush=True)
+                logger.close()
+                raise SystemExit(143)
+            final_acc = eval_fn(state)
 
     if manager is not None and cfg.checkpoint_every == 0:
         manager.save(int(state.step), state, force=True)

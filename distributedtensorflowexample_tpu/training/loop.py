@@ -22,16 +22,26 @@ from distributedtensorflowexample_tpu.training.state import TrainState
 class TrainLoop:
     def __init__(self, train_step, batches: Iterator, num_steps: int,
                  hooks: Iterable[Hook] = (), logger: MetricsLogger | None = None,
-                 steps_per_call: int = 1):
+                 steps_per_call: int = 1, should_stop=None):
         """``steps_per_call``: global steps one train_step call advances
         (the indexed step's ``unroll_steps``).  Hooks fire at call
-        boundaries; interval hooks handle strides that jump their mark."""
+        boundaries; interval hooks handle strides that jump their mark.
+
+        ``should_stop``: optional zero-arg callable polled at CALL
+        boundaries — the cooperative interruption point for signal-driven
+        stops (preemption SIGTERM).  Polling, not raising from the
+        handler, is load-bearing: the train step DONATES the input state,
+        so an exception landing inside the call after donation leaves
+        ``state`` pointing at deleted buffers and the save-on-exit path
+        crashes with "Array has been deleted" (observed).  At a boundary
+        the state is always the last completed step's."""
         self._train_step = train_step
         self._batches = batches
         self._num_steps = num_steps
         self._hooks = list(hooks)
         self._logger = logger or MetricsLogger()
         self._spc = max(1, steps_per_call)
+        self._should_stop = should_stop
         self.start_step = 0
 
     def run(self, state: TrainState) -> TrainState:
@@ -45,6 +55,8 @@ class TrainLoop:
         try:
             for step in range(start + self._spc, self._num_steps + 1,
                               self._spc):
+                if self._should_stop is not None and self._should_stop():
+                    break
                 state, metrics = self._train_step(state, next(self._batches))
                 self._logger.maybe_log(step, metrics)
                 # Every hook sees every step (no short-circuit) — a stop

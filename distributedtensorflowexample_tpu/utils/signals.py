@@ -1,0 +1,35 @@
+"""Scoped signal-handler installation (trainer preemption path).
+
+bench.py keeps its own inline copy of this pattern ON PURPOSE: importing
+any package module pulls in jax, and bench's record-survival contract
+requires its SIGTERM handler live BEFORE the first package import.  Keep
+the two restore semantics in sync."""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+
+@contextlib.contextmanager
+def installed_signal_handler(signum: int, handler):
+    """Install ``handler`` for ``signum`` — main thread only
+    (``signal.signal``'s requirement; other threads no-op and yield
+    False) — and restore the previous disposition on exit, so embedding
+    the caller in a larger process (pytest, a notebook) doesn't
+    permanently hijack its signals.
+
+    Restore detail: a previous handler installed by non-Python code
+    reads back as ``None``, which ``signal.signal`` refuses to accept —
+    restore ``SIG_DFL`` in that case rather than raising TypeError out
+    of the ``finally`` (which would mask the in-flight exit path).
+    """
+    install = threading.current_thread() is threading.main_thread()
+    prev = signal.signal(signum, handler) if install else None
+    try:
+        yield install
+    finally:
+        if install:
+            signal.signal(signum,
+                          prev if prev is not None else signal.SIG_DFL)

@@ -18,13 +18,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_workers(script_template: str, tmp_path,
-                     devices_per_proc: int = 1,
-                     timeout: int = 280) -> list[str]:
-    """Launch 2 OS worker processes with a reference-style TF_CONFIG, wait
-    for both, assert both exited 0, and return their outputs.
-    ``devices_per_proc`` > 1 gives each process that many virtual CPU
-    devices (the N-process x M-device topology of VERDICT r2 item 4)."""
+def _spawn_two_workers(script_template: str, tmp_path,
+                       devices_per_proc: int = 1, shared_logdir: bool = False,
+                       unbuffered: bool = False) -> list:
+    """Launch 2 OS worker processes with a reference-style TF_CONFIG and
+    return the running Popens (the ONE spawn contract every multihost
+    test shares).  ``devices_per_proc`` > 1 gives each process that many
+    virtual CPU devices; ``shared_logdir`` formats the same {logdir} into
+    both workers (the real multi-host checkpointing shape) instead of a
+    per-worker scratch dir."""
     workers = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
     procs = []
     for idx in range(2):
@@ -35,12 +37,23 @@ def _run_two_workers(script_template: str, tmp_path,
             '{"cluster": {"worker": ["%s", "%s"]}, '
             '"task": {"type": "worker", "index": %d}}'
             % (workers[0], workers[1], idx))
-        script = script_template.format(logdir=str(tmp_path / f"w{idx}"),
+        logdir = str(tmp_path / ("shared" if shared_logdir else f"w{idx}"))
+        script = script_template.format(logdir=logdir,
                                         ndev=devices_per_proc)
+        argv = [sys.executable] + (["-u"] if unbuffered else []) + \
+            ["-c", script]
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", script],
-            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            argv, env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    return procs
+
+
+def _run_two_workers(script_template: str, tmp_path,
+                     devices_per_proc: int = 1,
+                     timeout: int = 280) -> list[str]:
+    """Spawn (see _spawn_two_workers), wait for both, assert both exited
+    0, and return their outputs."""
+    procs = _spawn_two_workers(script_template, tmp_path, devices_per_proc)
     outputs = []
     try:
         for p in procs:
@@ -217,6 +230,93 @@ def test_nxm_training_all_modes(tmp_path):
         assert all("steps=4 replicas=8" in l for l in lines), lines
         accs = {l.split("acc=")[1] for l in lines}
         assert len(accs) == 1, f"{tag} diverged across processes: {lines}"
+
+
+_PREEMPT_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+from distributedtensorflowexample_tpu.data import mnist
+mnist._SYNTH_SIZES = {{"train": 256, "test": 128}}
+from distributedtensorflowexample_tpu.trainers import trainer_sync_mnist
+trainer_sync_mnist.main([
+    "--train_steps", "100000", "--batch_size", "8", "--global_batch",
+    "true", "--steps_per_loop", "1", "--log_every", "5",
+    "--log_dir", {logdir!r}, "--data_dir", "/nonexistent",
+    "--dataset", "synthetic", "--resume", "true",
+    "--learning_rate", "0.05",
+])
+"""
+
+
+def test_two_process_preemption_consensus(tmp_path):
+    """SIGTERM delivered to ONE worker only: the per-boundary stop
+    consensus (process_allgather of the local flag) must stop BOTH
+    processes at the same step — the un-signaled worker exits 143 too,
+    and the collective checkpoint save (ONE shared --log_dir, the real
+    multi-host deployment shape) completes instead of hanging in a
+    half-abandoned psum."""
+    import threading
+
+    procs = _spawn_two_workers(_PREEMPT_SCRIPT, tmp_path,
+                               shared_logdir=True, unbuffered=True)
+    logs = [[], []]
+    progressed = threading.Event()
+
+    def drain(i):
+        for line in procs[i].stdout:
+            logs[i].append(line)
+            if i == 0 and line.startswith("step ") and "loss" in line:
+                progressed.set()
+        if i == 0:
+            progressed.set()           # EOF: unblock the waiter
+
+    threads = [threading.Thread(target=drain, args=(i,), daemon=True)
+               for i in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        assert progressed.wait(timeout=300), "no training progress"
+        assert procs[0].poll() is None, "".join(logs[0])[-2000:]
+        procs[0].terminate()           # ONLY worker 0 is preempted
+        for p in procs:
+            p.wait(timeout=280)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for t in threads:
+            t.join(timeout=30)
+    out0, out1 = "".join(logs[0]), "".join(logs[1])
+    assert procs[0].returncode == 143, (procs[0].returncode, out0[-2000:])
+    assert procs[1].returncode == 143, (procs[1].returncode, out1[-2000:])
+    # Chief (worker 0) announces the save; the collective checkpoint
+    # landed in the shared directory.
+    assert "SIGTERM at step" in out0, out0[-2000:]
+    assert "SIGTERM at step" not in out1          # chief-only notice
+    saved_dirs = [d for d in (tmp_path / "shared" / "checkpoints").iterdir()
+                  if d.name.isdigit()]
+    assert saved_dirs, out0[-1000:]
+
+
+def test_divergent_checkpoint_dirs_fail_by_name(tmp_path):
+    """Processes pointed at DIFFERENT --log_dir values with checkpointing
+    on must fail with the named error up front — the alternative is a
+    split-brain Orbax barrier that wedges the first save (observed)."""
+    procs = _spawn_two_workers(_PREEMPT_SCRIPT, tmp_path, unbuffered=True)
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=280)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for idx, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode != 0, f"worker {idx} unexpectedly succeeded"
+        assert "differs across the 2 processes" in out, (idx, out[-2000:])
 
 
 _NXM_EVAL_SCRIPT = """

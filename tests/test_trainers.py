@@ -82,6 +82,71 @@ def test_mirrored_resnet_smoke(tmp_log_dir, small_synthetic):
     assert np.isfinite(summary["final_accuracy"])
 
 
+def test_sigterm_preemption_saves_and_resumes(tmp_path):
+    """TPU preemption parity (SURVEY §5 failure recovery): the platform
+    sends SIGTERM before reclaiming a slice — the trainer must write a
+    final checkpoint, exit 143, and auto-resume on restart.  Subprocess
+    test: signal handlers need the trainee's own main thread."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""   # CPU backend in the child
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [sys.executable, "-u", "-m",
+            "distributedtensorflowexample_tpu.trainers.trainer_sync_mnist",
+            "--batch_size", "32", "--dataset", "synthetic",
+            "--steps_per_loop", "1", "--log_every", "5",
+            "--log_dir", str(tmp_path), "--learning_rate", "0.01"]
+    import threading
+
+    p = subprocess.Popen(args + ["--train_steps", "100000"], env=env,
+                         cwd=root, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    saw = []
+    got_step = threading.Event()
+
+    def drain():
+        # Deadline-safe: a blocking for-line read on the main thread
+        # could hang the whole session if the child wedges pre-output.
+        for line in p.stdout:
+            saw.append(line)
+            if line.startswith("step ") and "loss" in line:
+                got_step.set()
+        got_step.set()                 # EOF: unblock the waiter either way
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    try:
+        assert got_step.wait(timeout=300), "no output within deadline"
+        assert p.poll() is None, (
+            "trainer exited early:\n" + "".join(saw)[-2000:])
+        p.terminate()                  # the platform's preemption signal
+        p.wait(timeout=240)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+        t.join(timeout=30)
+    full = "".join(saw)
+    assert p.returncode == 143, (p.returncode, full[-2000:])
+    m = re.search(r"SIGTERM at step (\d+): checkpoint saved", full)
+    assert m, full[-2000:]
+    saved = int(m.group(1))
+    assert saved >= 5
+
+    r = subprocess.run(args + ["--train_steps", str(saved + 10)], env=env,
+                       cwd=root, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]
+    assert f"resumed from checkpoint at step {saved}" in r.stdout, \
+        r.stdout[-2000:]
+    assert f"step {saved + 10}: final_accuracy" in r.stdout
+
+
 def test_multiworker_trainer_single_process(tmp_log_dir, small_synthetic):
     """Config 5 entrypoint degenerates correctly to one process (the same
     SPMD program; the mesh simply spans one host's devices)."""
