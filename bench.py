@@ -480,10 +480,10 @@ def main() -> None:
         # no consumer can mistake the line for a measured 100% regression
         # (round 2's 0.0 steps/sec/chip line read exactly that way).
         detail = {"error": why[:500], "probe_attempts": attempts_[-8:],
-                  "see": "OUTAGE_r04.md (this round's backend log), "
-                         "BENCH_early_r03.json (round-3 early capture), "
-                         "BENCH_manual_r02.json (full on-chip run, "
-                         "2026-07-30), and BASELINE.md"}
+                  "see": "OUTAGE_r05.md (continuous outage spanning "
+                         "rounds 3-5), BENCH_early_r03.json (round-3 "
+                         "early capture), BENCH_manual_r02.json (full "
+                         "on-chip run, 2026-07-30), and BASELINE.md"}
         if provisional:
             detail["provisional"] = True
         if errors_:
