@@ -95,13 +95,19 @@ check_capture() {
   return 0
 }
 
-# $1 = ts, $2 = stale threshold (empty/0 = never kill).  Sweeps BOTH
-# bench.py and bench_profile.py (anchored — bench_scaling/bench_input
-# never hold the chip long).  0 = a live one remains (it IS the
-# capture), 1 = none.
+# $1 = ts, $2 = stale threshold (empty/0 = never kill).  Sweeps
+# bench.py, bench_profile.py (anchored — bench_scaling/bench_input
+# never hold the chip long) AND the capture's phase-4 trainer run,
+# matched by ITS unique --log_dir (a bare trainer pattern would also
+# match CPU-only trainer subprocesses from the test suite, and a young
+# one at a recovery edge would suppress the window's capture launch).
+# If the capture shell dies without its children, the orphaned trainer
+# keeps holding the chip and must be sweepable like the bench.
+# 0 = a live one remains (it IS the capture), 1 = none.
 check_orphan_bench() {
   local ts="$1" kill_over="${2:-0}" young=1 pid age pat
-  for pat in "python bench\.py" "python bench_profile\.py"; do
+  for pat in "python bench\.py" "python bench_profile\.py" \
+             "trainers\.trainer_.*cli_bench_r05"; do
     for pid in $(pgrep -f "$pat"); do
       age=$(proc_age "$pid")
       [ -n "$age" ] || continue
