@@ -21,16 +21,19 @@ from distributedtensorflowexample_tpu.parallel.sync import (
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
-def _run(seed: int, steps: int = 10):
+def _run(seed: int, steps: int = 10, data_sharding: str = "replicated"):
     """A short sync-DP training run on the mesh, returning final params."""
     mesh = make_mesh()
     x, y = make_synthetic(512, (28, 28, 1), 10, seed=0)
     b = 64
-    ds = DeviceDataset(x, y, b, mesh=mesh, seed=seed)
+    ds = DeviceDataset(x, y, b, mesh=mesh, seed=seed,
+                       data_sharding=data_sharding)
     state = TrainState.create_sharded(
         build_model("mnist_cnn", dropout=0.5), optax.sgd(0.05, momentum=0.9),
         (b, 28, 28, 1), seed, replicated_sharding(mesh))
-    step = make_indexed_train_step(b, ds.steps_per_epoch, mesh=mesh)
+    step = make_indexed_train_step(b, ds.steps_per_epoch, mesh=mesh,
+                                   num_slots=ds.num_slots,
+                                   data_sharding=data_sharding)
     with mesh:
         for _ in range(steps):
             state, m = step(state, next(ds))
@@ -40,6 +43,14 @@ def _run(seed: int, steps: int = 10):
 
 def test_same_seed_bitwise_identical():
     p1, p2 = _run(seed=3), _run(seed=3)
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c), p1, p2)
+
+
+def test_same_seed_bitwise_identical_sharded_storage():
+    """The determinism contract holds for the sharded-resident layout
+    too: same seed ⇒ bit-identical params across independent runs."""
+    p1 = _run(seed=3, data_sharding="sharded")
+    p2 = _run(seed=3, data_sharding="sharded")
     jax.tree.map(lambda a, c: np.testing.assert_array_equal(a, c), p1, p2)
 
 
