@@ -568,6 +568,43 @@ def test_sharded_gather_with_device_augment():
         assert set(np.unique(row)) <= set(np.unique(src))
 
 
+def test_sharded_gather_adds_no_collectives():
+    """The design claim, pinned in the compiled HLO: the sharded-resident
+    gather is collective-free — the full train step's collective set is
+    IDENTICAL to the replicated-storage step's (the one fused gradient
+    all-reduce), no all-gather/all-to-all introduced by the row-sharded
+    operands."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench_scaling import collective_traffic
+
+    mesh = make_mesh()
+    x, y = _data(512)
+    b = 64
+
+    def compiled_traffic(data_sharding):
+        ds = DeviceDataset(x, y, b, mesh=mesh, seed=0,
+                           data_sharding=data_sharding)
+        state = TrainState.create_sharded(
+            build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
+            replicated_sharding(mesh))
+        step = make_indexed_train_step(b, ds.steps_per_epoch, mesh=mesh,
+                                       num_slots=ds.num_slots,
+                                       data_sharding=data_sharding)
+        with mesh:
+            hlo = step.lower(state, ds.peek()).compile().as_text()
+        return {op: c for op, c in collective_traffic(hlo).items()
+                if c["count"]}
+
+    repl = compiled_traffic("replicated")
+    shard = compiled_traffic("sharded")
+    assert repl == shard, (repl, shard)
+    assert set(repl) <= {"all-reduce"}, repl   # just the gradient psum
+
+
 def test_sharded_dataset_reduces_per_device_bytes():
     """The whole point: per-device HBM for the split is 1/D of the
     replicated footprint (same totals, same dtype)."""
