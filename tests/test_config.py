@@ -126,3 +126,15 @@ def test_quantize_flag_parses_and_validates():
     cfg = parse_flags(["--quantize", "off"])
     assert cfg.quantize == "off"
     assert RunConfig().quantize == "auto"
+
+
+def test_round5_flag_defaults_and_parsing():
+    """Round-5 surface: auto unroll is the shipped default, sharded
+    storage is opt-in, and both parse from the CLI."""
+    cfg = parse_flags([])
+    assert cfg.steps_per_loop == 0          # 0 = auto
+    assert cfg.data_sharding == "replicated"
+    cfg = parse_flags(["--steps_per_loop", "1",
+                       "--data_sharding", "sharded"])
+    assert cfg.steps_per_loop == 1
+    assert cfg.data_sharding == "sharded"
