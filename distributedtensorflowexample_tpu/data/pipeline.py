@@ -66,14 +66,16 @@ class Batcher:
                  augment_fn: Callable[[np.ndarray, np.random.RandomState],
                                       np.ndarray] | None = None,
                  quantize: str = "auto"):
-        """``quantize="auto"`` (default) keeps a bitwise-recoverable
-        8-bit split as uint8 (see ``device_dataset._try_quantize``), so
-        every per-step host gather AND host->device upload moves 4x
-        fewer bytes — the H2D copy is this path's bottleneck at small
-        step times.  The consumer step must then be built with
+        """``quantize`` != "off" keeps a bitwise-recoverable 8-bit split
+        as uint8 (see ``device_dataset._try_quantize``), so every
+        per-step host gather AND host->device upload moves 4x fewer
+        bytes — the H2D copy is this path's bottleneck at small step
+        times.  The consumer step must then be built with
         ``dequant=batcher.dequant`` (enforced at trace time by
-        ``parallel.sync.dequant_host_batch``); the device-side LUT
-        reproduces the loader's float32 values bitwise.  Crop/flip
+        ``parallel.sync.dequant_host_batch``); the device-side dequant
+        here is always the exact LUT (H2D dominates this path, so the
+        "scale"/"exact" distinction of the resident path buys nothing —
+        both select uint8 storage).  Crop/flip
         augmentation is pure pixel rearrangement, so it runs on the
         uint8 batch unchanged — the native C++ gather/augment kernels
         have uint8 variants (dataio.cc), so the fused path applies."""
@@ -84,7 +86,7 @@ class Batcher:
             raise ValueError(
                 f"dataset of {len(images)} examples is smaller than the "
                 f"global batch {batch_size}; shapes downstream are static")
-        if quantize not in ("auto", "off"):
+        if quantize not in ("auto", "off", "exact", "scale"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         # Quantization is only valid when the augment hook is a pure
         # pixel rearrangement (crop/flip — marked ``u8_safe`` on the
@@ -103,7 +105,7 @@ class Batcher:
                 from distributedtensorflowexample_tpu.data.device_dataset \
                     import _dequant_numpy
                 images = _dequant_numpy(images, "unit")
-        elif quantize == "auto" and u8_safe:
+        elif quantize != "off" and u8_safe:
             from distributedtensorflowexample_tpu.data.device_dataset import (
                 _try_quantize)
             q = _try_quantize(np.asarray(images))

@@ -83,11 +83,13 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
     async indexed step builders.  ``num_slots`` must equal the dataset's
     perm-ring size (``ds.num_slots``).
 
-    A uint8-resident split (4x less gather traffic) dequantizes to the
-    loader's exact float32 values on the gathered batch only: the LUT
-    rides in ``data["lut"]`` and the dispatch is on the resident dtype
-    (static at trace time), so quantization needs NO step-factory
-    plumbing and no call site can silently train on raw bytes.
+    A uint8-resident split (4x less gather traffic) dequantizes on the
+    gathered batch only: the dequant constants ride in the data pytree
+    (``data["lut"]`` for the exact one-hot-matmul path,
+    ``data["dq_scale"]/["dq_bias"]`` for the fused affine path) and the
+    dispatch is on the pytree structure (static at trace time), so
+    quantization needs NO step-factory plumbing and no call site can
+    silently train on raw bytes.
 
     ``data_sharding="sharded"`` pairs with a row-sharded
     ``DeviceDataset(data_sharding="sharded")``: each device gathers its
@@ -129,8 +131,12 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
             img = cifar_augment_device(img, akey)
         if img.dtype == jnp.uint8:
             from distributedtensorflowexample_tpu.data.device_dataset import (
-                apply_dequant_lut)
-            img = apply_dequant_lut(img, data["lut"])
+                apply_dequant_affine, apply_dequant_lut)
+            if "lut" in data:
+                img = apply_dequant_lut(img, data["lut"])
+            else:
+                img = apply_dequant_affine(img, data["dq_scale"],
+                                           data["dq_bias"])
         batch = {"image": img,
                  "label": jnp.take(data["labels"], idx, axis=0)}
         if mesh is not None and mesh.size > 1:
@@ -163,8 +169,9 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
 
     def gather(step, rng, data):
         has_lut = "lut" in data
+        has_affine = "dq_scale" in data
 
-        def local(step, rng, images, labels, perm, lut=None):
+        def local(step, rng, images, labels, perm, *dq):
             d = jax.lax.axis_index(DATA_AXIS)
             rows = images.shape[0]              # this device's row block
             slot = (step // steps_per_epoch) % num_slots
@@ -184,8 +191,11 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
                 img = cifar_augment_device(img, akey)
             if img.dtype == jnp.uint8:
                 from distributedtensorflowexample_tpu.data.device_dataset import (
-                    apply_dequant_lut)
-                img = apply_dequant_lut(img, lut)
+                    apply_dequant_affine, apply_dequant_lut)
+                if has_lut:
+                    img = apply_dequant_lut(img, dq[0])
+                else:
+                    img = apply_dequant_affine(img, dq[0], dq[1])
             return img, jnp.take(labels, idx, axis=0)
 
         args = [step, rng, data["images"], data["labels"], data["perm"]]
@@ -193,6 +203,9 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
         if has_lut:
             args.append(data["lut"])
             in_specs.append(P())
+        elif has_affine:
+            args.extend([data["dq_scale"], data["dq_bias"]])
+            in_specs.extend([P(), P()])
         img, lab = jax.shard_map(
             local, mesh=mesh, in_specs=tuple(in_specs),
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)(*args)
@@ -409,22 +422,26 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     batch row-wise over the mesh, and jits a ``lax.scan`` over the batches
     — the whole eval is a single compiled call returning one scalar.
     Like the train split, a quantizable split is held as uint8 (4x less
-    HBM + upload) and LUT-dequantized in the scan body — bitwise the
-    same floats (see ``data.device_dataset.dequantize_images``).
-    ``quantize`` mirrors the train-path flag: ``"off"`` keeps the split
-    float32-resident (the --quantize escape hatch reaches eval too).
+    HBM + upload) and dequantized in the scan body.  ``quantize``
+    mirrors the train-path flag: ``"off"`` keeps the split
+    float32-resident, ``"exact"`` dequantizes bitwise through the LUT
+    (``data.device_dataset.dequantize_images``), ``"scale"``/``"auto"``
+    use the fused affine form (~1 ulp, fastest — see
+    ``make_dequant_affine``).
 
     Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
     """
     import numpy as np
 
     from distributedtensorflowexample_tpu.data.device_dataset import (
-        _try_quantize, dequantize_images)
+        _try_quantize, apply_dequant_affine, dequantize_images,
+        make_dequant_affine)
 
-    if quantize not in ("auto", "off"):
+    if quantize not in ("auto", "off", "exact", "scale"):
         raise ValueError(f"unknown quantize mode {quantize!r}")
+    mode = "scale" if quantize == "auto" else quantize
     dequant = None
-    if quantize == "auto":
+    if mode in ("scale", "exact"):
         q = _try_quantize(np.asarray(images))
         if q is not None:
             images, dequant = q
@@ -469,7 +486,12 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
         def body(total, xy):
             bx, by = xy
             if dequant is not None:
-                bx = dequantize_images(bx, dequant)
+                if mode == "exact":
+                    bx = dequantize_images(bx, dequant)
+                else:
+                    s, b = make_dequant_affine(dequant)
+                    bx = apply_dequant_affine(bx, jnp.asarray(s),
+                                              jnp.asarray(b))
             logits = state.apply_fn(variables, bx, train=False)
             correct = jnp.sum(
                 (jnp.argmax(logits, axis=-1) == by).astype(jnp.int32))

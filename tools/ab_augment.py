@@ -1,0 +1,165 @@
+"""A/B the device augment's crop implementation on chip (VERDICT r4 #2/#3).
+
+The round-5 trace (PROFILE_auto_r05.json window, /tmp/resnet_trace)
+shows the vmap'd per-image ``dynamic_slice`` crop in
+``augment_device.cifar_augment_device`` lowering to a SERIAL
+256-iteration while loop (~4.4 ms/step of the ResNet-20 step's ~14.9),
+and the per-channel LUT dequant gather costing another ~8.2 ms.  This
+harness times the INPUT PATH ALONE (resident-split gather + augment +
+dequant over a scanned window, no model) for crop/dequant variants:
+
+  base      current code: vmap dynamic_slice crop + LUT-gather dequant
+  selmm     selector-matmul crop+flip (one-hot row/col matrices, MXU)
+            + LUT-gather dequant
+  selmm_oh  selector-matmul crop + one-hot-matmul dequant (full MXU
+            input path)
+  noaug     gather + LUT dequant only (bounds what augment can save)
+
+All selector/one-hot forms are exact pixel routing (single nonzero term
+per output element), so a win here carries over bitwise.
+
+Run detached, never under a harness timeout:
+  setsid nohup python tools/ab_augment.py > AB_augment_r05.json 2>/tmp/ab_augment.log &
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPEATS = 3
+
+
+def _emit(obj) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def selector_crop_flip(images, key):
+    """cifar_augment_device's transform (same RNG draws, same reflect
+    pad) with the per-image crop+flip expressed as two one-hot selector
+    batched matmuls instead of vmap(dynamic_slice) — pure MXU work, no
+    serial per-image loop.  Exact: every output pixel is 1.0 * one input
+    pixel (uint8 values <= 255 are exact in bfloat16)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtensorflowexample_tpu.data.augment_device import PAD
+
+    b, h, w, c = images.shape
+    ky, kx, kf = jax.random.split(key, 3)
+    ys = jax.random.randint(ky, (b,), 0, 2 * PAD + 1)
+    xs = jax.random.randint(kx, (b,), 0, 2 * PAD + 1)
+    flips = jax.random.bernoulli(kf, 0.5, (b,))
+    padded = jnp.pad(images, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)),
+                     mode="reflect")
+    hp = h + 2 * PAD
+    # R[b, r, hh] = (hh == ys[b] + r): picks output row r from padded
+    # row ys[b]+r.
+    rows = ys[:, None, None] + jnp.arange(h)[None, :, None]
+    R = (jnp.arange(hp)[None, None, :] == rows).astype(jnp.bfloat16)
+    # Cc[b, ww, k] = (ww == xs[b] + (flip ? w-1-k : k)): column pick and
+    # horizontal flip folded into one selector.
+    k = jnp.arange(w)[None, None, :]
+    src = jnp.where(flips[:, None, None], w - 1 - k, k) + xs[:, None, None]
+    Cc = (jnp.arange(hp)[None, :, None] == src).astype(jnp.bfloat16)
+    x = padded.astype(jnp.bfloat16)
+    out = jnp.einsum("brh,bhwc->brwc", R, x,
+                     preferred_element_type=jnp.float32)
+    out = jnp.einsum("brwc,bwk->brkc", out.astype(jnp.bfloat16), Cc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(images.dtype)
+
+
+def apply_dequant_onehot(u8, lut):
+    import jax
+    import jax.numpy as jnp
+    oh = jax.nn.one_hot(u8, 256, dtype=jnp.bfloat16)
+    if lut.ndim == 1:
+        return jnp.einsum("...k,k->...", oh, lut.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("...ck,kc->...c", oh, lut.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+
+
+def make_input_only(variant: str, mesh, batch: int, unroll: int):
+    """A jitted (step0, rng, data) -> f32 checksum running `unroll`
+    gather+augment+dequant iterations, no model."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtensorflowexample_tpu.data import device_dataset as dd
+    from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
+    from distributedtensorflowexample_tpu.parallel import sync as psync
+
+    train_x, train_y = load_cifar10("/tmp/data", "train", source="fallback")
+    ds = dd.DeviceDataset(train_x, train_y, batch, mesh=mesh, seed=0,
+                          steps_per_next=unroll)
+    augment = "none" if variant == "noaug" else "cifar"
+    gather = psync.make_device_gather(batch, ds.steps_per_epoch,
+                                      augment=augment, mesh=mesh,
+                                      num_slots=ds.num_slots)
+
+    @jax.jit
+    def run(rng, data):
+        def body(carry, step):
+            b = gather(step, rng, data)
+            return carry + jnp.sum(b["image"][0, 0, 0].astype(
+                jnp.float32)), None
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(unroll))
+        return out
+
+    data = next(ds)
+    rng = jax.random.PRNGKey(0)
+    return functools.partial(run, rng, data)
+
+
+def main() -> None:
+    import jax
+
+    from distributedtensorflowexample_tpu.parallel import make_mesh
+
+    smoke = os.environ.get("AB_SMOKE") == "1"
+    batch = 64 if smoke else 256
+    unroll = 8 if smoke else 195
+
+    from distributedtensorflowexample_tpu.data import augment_device
+    from distributedtensorflowexample_tpu.data import device_dataset as dd
+
+    orig_crop = augment_device.cifar_augment_device
+    orig_lut = dd.apply_dequant_lut
+    mesh = make_mesh()
+    for variant in ("base", "selmm", "selmm_oh", "noaug"):
+        # Patches must span build AND the first (tracing) call: the
+        # gather resolves these module attrs at trace time.
+        if variant in ("selmm", "selmm_oh"):
+            augment_device.cifar_augment_device = selector_crop_flip
+        if variant == "selmm_oh":
+            dd.apply_dequant_lut = apply_dequant_onehot
+        try:
+            run = make_input_only(variant, mesh, batch, unroll)
+            jax.block_until_ready(run())  # compile + warmup
+            rates = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run())
+                rates.append(unroll / (time.perf_counter() - t0))
+            _emit({"metric": f"input_path_{variant}_steps_per_sec",
+                   "value": round(max(rates), 2), "unit": "steps/sec",
+                   "detail": {"repeats": [round(r, 1) for r in rates],
+                              "batch": batch, "unroll": unroll}})
+        except Exception as e:
+            _emit({"metric": f"input_path_{variant}_steps_per_sec",
+                   "value": 0.0, "unit": "error",
+                   "detail": {"error": repr(e)}})
+        finally:
+            augment_device.cifar_augment_device = orig_crop
+            dd.apply_dequant_lut = orig_lut
+
+
+if __name__ == "__main__":
+    main()

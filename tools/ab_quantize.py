@@ -1,0 +1,127 @@
+"""A/B the headline's resident-split storage on chip (VERDICT r4 #1/#3).
+
+Round 5's first recovery window measured the headline at 477.9
+steps/s/chip against a same-window roofline probe of ~1,870 — a ~3.5x
+gap the round-2 record (1,681, vs_roofline ~0.94) did not have.  The
+ONE headline-path change since that record is the round-4 uint8-resident
+split (BASELINE.md "Round-4 core change"), whose predicted win was never
+measured.  This harness separates the suspects in a single window:
+
+  off       float32-resident split           (the round-2 path)
+  auto      uint8 + LUT gather dequant       (the current default)
+  u8_mul    uint8 + convert*(1/255)          (NOT bitwise; isolates the
+                                              LUT gather from the u8 row
+                                              gather)
+  u8_onehot uint8 + one-hot @ LUT matmul     (bitwise-exact: the sum has
+                                              exactly one nonzero term;
+                                              MXU-friendly gather)
+
+Each variant is the exact headline configuration (mnist_cnn sync, batch
+256/chip, deepest unroll) timed with bench.py's own _measure, plus one
+shared same-window roofline probe for cross-window calibration.  One
+JSON line per variant, flushed as it lands.
+
+Run detached, never under a harness timeout (tools/bench_capture.sh
+header explains why):  setsid nohup python tools/ab_quantize.py > AB_quantize_r05.json 2>/tmp/ab_quantize.log &
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # repo-root bench.py: _measure, _roofline_probe, REPEATS
+
+
+def _emit(obj) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def apply_dequant_onehot(u8, lut):
+    """Bitwise-exact LUT lookup as a one-hot matmul: the dot's sum has
+    exactly one nonzero term per output element, so the float result is
+    the LUT entry itself (no rounding).  Trades the elementwise dynamic
+    gather (a shape TPUs lower poorly) for an MXU contraction."""
+    import jax
+    import jax.numpy as jnp
+    oh = jax.nn.one_hot(u8, 256, dtype=lut.dtype)
+    if lut.ndim == 1:
+        return oh @ lut
+    return jnp.einsum("...ck,kc->...c", oh, lut)
+
+
+def apply_dequant_multiply(u8, lut):
+    """NOT bitwise-exact (XLA's reciprocal multiply is ~1 ulp off on
+    ~40% of values — device_dataset.make_dequant_lut).  Diagnostic only:
+    bounds what exactness costs vs a plain convert+scale."""
+    del lut
+    import jax.numpy as jnp
+    return u8.astype(jnp.float32) / 255.0
+
+
+def main() -> None:
+    unroll_epochs = int(os.environ.get("AB_UNROLL_EPOCHS", "16"))
+    calls_per_repeat = int(os.environ.get("AB_CALLS", "2"))
+    smoke = os.environ.get("AB_SMOKE") == "1"
+
+    from distributedtensorflowexample_tpu.data import device_dataset as dd
+    from distributedtensorflowexample_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    b = bench.BATCH["cnn"]
+    spe = bench.TRAIN_N["mnist"] // (b * mesh.size)
+    unroll = unroll_epochs * spe
+    if smoke:
+        # Wiring check (CPU: JAX_PLATFORMS=cpu): shallow unroll so all
+        # four variants trace/execute in minutes.  Rates are
+        # meaningless; the point is that every variant builds and runs
+        # end to end through the monkeypatch plumbing.
+        unroll = 16
+    else:
+        cost = {}
+        probe_rates = bench._roofline_probe(
+            mesh, b, length=bench.ROOFLINE_LEN["headline"], cost_out=cost)
+        _emit({"metric": "roofline_probe", "repeats": probe_rates,
+               "cost_per_step": cost})
+
+    orig_lut = dd.apply_dequant_lut
+    variants = {
+        "off": ("off", orig_lut),
+        "auto": ("auto", orig_lut),
+        "u8_mul": ("auto", apply_dequant_multiply),
+        "u8_onehot": ("auto", apply_dequant_onehot),
+    }
+    for name, (qmode, dequant) in variants.items():
+        dd.apply_dequant_lut = dequant
+        try:
+            real_init = dd.DeviceDataset.__init__
+
+            def patched_init(self, *a, **kw):
+                kw["quantize"] = qmode
+                real_init(self, *a, **kw)
+
+            dd.DeviceDataset.__init__ = patched_init
+            try:
+                step, ds, state, u = bench._make("mnist_cnn", "mnist", b,
+                                                 unroll, mesh)
+            finally:
+                dd.DeviceDataset.__init__ = real_init
+            best, rates, _ = bench._measure(step, ds, state,
+                                            calls_per_repeat * unroll, u)
+            _emit({"metric": f"headline_{name}_steps_per_sec_per_chip",
+                   "value": round(best, 2), "unit": "steps/sec/chip",
+                   "detail": {"repeats": rates, "unroll": u,
+                              "batch_per_chip": b, "quantize": qmode,
+                              "dequant": dequant.__name__}})
+        except Exception as e:  # fault-isolate: later variants still run
+            _emit({"metric": f"headline_{name}_steps_per_sec_per_chip",
+                   "value": 0.0, "unit": "error", "detail": {"error": repr(e)}})
+        finally:
+            dd.apply_dequant_lut = orig_lut
+
+
+if __name__ == "__main__":
+    main()
