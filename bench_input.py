@@ -52,8 +52,15 @@ def bench_cifar_parse(n_records: int = 10000) -> None:
     rows = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3073)
 
     def numpy_parse():
+        from distributedtensorflowexample_tpu.data.dequant import (
+            U8_UNIT_SCALE)
         nhwc = rows[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return nhwc.astype(np.float32) / 255.0, rows[:, 0].astype(np.int32)
+        # Multiply by the canonical f32 1/255 (data/dequant.py), matching
+        # both the loaders and the native parser — a division rounds
+        # differently on 126/256 byte values and breaks the bit-identity
+        # assertion below.
+        return (nhwc.astype(np.float32) * U8_UNIT_SCALE,
+                rows[:, 0].astype(np.int32))
 
     ni, nl = native.parse_cifar(raw)
     pi, pl = numpy_parse()
@@ -77,9 +84,12 @@ def bench_idx_parse(n: int = 60000) -> None:
     raw = struct.pack(">IIII", 2051, n, 28, 28) + body.tobytes()
 
     def numpy_parse():
+        from distributedtensorflowexample_tpu.data.dequant import (
+            U8_UNIT_SCALE)
         data = np.frombuffer(raw, dtype=np.uint8, count=n * 28 * 28,
                              offset=16)
-        return data.reshape(n, 28, 28, 1).astype(np.float32) / 255.0
+        # Canonical multiply, not divide — see bench_cifar_parse.
+        return data.reshape(n, 28, 28, 1).astype(np.float32) * U8_UNIT_SCALE
 
     np.testing.assert_array_equal(native.parse_idx_images(raw),
                                   numpy_parse())

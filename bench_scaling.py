@@ -102,18 +102,24 @@ def main() -> None:
         # force-registers the axon platform over JAX_PLATFORMS, so the
         # in-process config route is the only one that works).
         import os
+
+        from distributedtensorflowexample_tpu.compat import (
+            cpu_collective_flags, set_num_cpu_devices)
         if "collective_call_terminate" not in os.environ.get("XLA_FLAGS", ""):
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
-                + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-                + " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+                + cpu_collective_flags(warn_s=120, terminate_s=600))
         for knob, value in (("jax_platforms", "cpu"),
-                            ("jax_num_cpu_devices", args.max_devices),
                             ("jax_cpu_enable_async_dispatch", False)):
             try:
                 jax.config.update(knob, value)
             except RuntimeError:
                 break
+        else:
+            try:
+                set_num_cpu_devices(args.max_devices)
+            except RuntimeError:
+                pass
 
     import jax.numpy as jnp
     import optax

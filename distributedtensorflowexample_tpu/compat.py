@@ -1,0 +1,106 @@
+"""Version shims for the jax API surface this codebase targets.
+
+The code is written against the current jax API (``jax.shard_map`` with
+``check_vma``, the ``jax_num_cpu_devices`` config); the image may pin an
+older jax (0.4.x exposes ``jax.experimental.shard_map.shard_map`` with
+``check_rep`` and configures virtual CPU devices only through the
+``--xla_force_host_platform_device_count`` XLA flag).  Every call site
+goes through these two helpers so the rest of the tree reads as
+current-API code and the pin is handled in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on current jax; the ``jax.experimental``
+    spelling (``check_rep``) on 0.4.x.  Keyword-only like the new API."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """Configure ``n`` virtual CPU devices BEFORE first backend use.
+
+    Current jax has the ``jax_num_cpu_devices`` config; 0.4.x only honors
+    the ``--xla_force_host_platform_device_count`` XLA flag, which is read
+    at backend-client creation, so rewriting ``XLA_FLAGS`` here still
+    takes effect as long as no jax computation has run yet (the same
+    contract the config option has).  An inherited pin (a parent process
+    exporting its own count into our environment — the subprocess-test
+    shape) is REPLACED while the backend is uninitialized; once backends
+    exist, a conflicting value raises like the config route does, instead
+    of silently keeping the old count.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return
+    except AttributeError:
+        pass
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    keep = [t for t in flags.split() if not t.startswith(flag)]
+    want = f"{flag}={int(n)}"
+    if want not in flags.split():
+        # The count is actually changing: past backend init the flag is
+        # never re-read, so succeeding silently here would strand the
+        # caller with the old device count (the config route raises in
+        # exactly this situation).
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            raise RuntimeError(
+                f"backend already initialized with a different CPU device "
+                f"count (XLA_FLAGS {flags!r}); cannot re-pin to {n} — the "
+                f"flag is read once at backend init")
+    os.environ["XLA_FLAGS"] = " ".join(keep + [want]).strip()
+
+
+def cpu_collective_flags(warn_s: int = 60, terminate_s: int = 300) -> str:
+    """The XLA:CPU collective-rendezvous deadline flags, or "" when this
+    jaxlib predates them.  An UNKNOWN name in XLA_FLAGS is a FATAL abort
+    at first backend init (parse_flags_from_env.cc), so the flags must be
+    version-gated, not passed hopefully; 0.4.x jaxlibs don't have them
+    (and their looser default rendezvous behavior needs no lifting)."""
+    if jax.__version_info__ < (0, 5, 0):
+        return ""
+    return (f" --xla_cpu_collective_call_warn_stuck_timeout_seconds={warn_s}"
+            f" --xla_cpu_collective_call_terminate_timeout_seconds="
+            f"{terminate_s}")
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` on jax versions that have it;
+    on 0.4.x (which predates the public predicate) the same answer read
+    from the runtime's global state — a live coordinator client."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    from jax._src import distributed
+    return distributed.global_state.client is not None
+
+
+def enable_persistent_compilation_cache(
+        cache_dir: str, min_compile_secs: float = 0.5) -> None:
+    """Enable jax's persistent compilation cache — only on jax versions
+    where a deserialized executable is trustworthy.
+
+    On 0.4.x jaxlibs a cache HIT on a program with donated arguments
+    comes back without its donation write-back: reproduced on
+    jax 0.4.37 / jaxlib 0.4.36 — the jitted train step's BN running
+    stats return bitwise-unchanged from a cache-loaded executable while
+    the identical program freshly compiled updates them (same loss, so
+    the corruption is silent).  A silently wrong training step costs
+    more than every compile the cache saves, so on those versions this
+    is a no-op and every process pays its own compiles."""
+    if jax.__version_info__ < (0, 5, 0):
+        return
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))

@@ -104,10 +104,21 @@ class RunConfig:
                                     # dispatch per step
     quantize: str = "auto"          # auto | off | exact | scale — hold
                                     # 8-bit-exact splits as uint8 (4x less
-                                    # HBM + gather/upload bytes); scale =
-                                    # fused affine dequant (~1 ulp,
-                                    # fastest), exact = one-hot-matmul LUT
-                                    # (bitwise), auto = scale
+                                    # HBM + gather/upload bytes); all of
+                                    # auto/exact/scale select uint8
+                                    # storage, off keeps float32
+    dequant_impl: str = "auto"      # auto | affine | onehot | lut |
+                                    # pallas — the in-step dequant kernel
+                                    # for quantized splits.  auto lowers
+                                    # to the fused affine (bitwise-
+                                    # verified against the 256-entry LUT
+                                    # per split; true for MNIST/CIFAR),
+                                    # falling back to the one-hot form
+                                    # only for non-affine-representable
+                                    # splits; lut is the known-slow
+                                    # elementwise-gather diagnostic;
+                                    # pallas fuses gather+dequant into
+                                    # one kernel (replicated data only)
     data_sharding: str = "replicated"  # replicated | sharded — sharded
                                     # splits the resident dataset row-wise
                                     # over the mesh (per-device HBM /
@@ -191,11 +202,20 @@ _FLAG_HELP = {
                       "dispatch per step",
     "quantize": "auto | off | exact | scale — store 8-bit-exact splits "
                 "as uint8 in HBM/host memory (4x less gather and upload "
-                "traffic; 8-bit recoverability verified at build time). "
-                "scale = fused affine dequant, ~1 ulp from the loader's "
-                "floats, fastest (measured 1.19x over float32 storage); "
-                "exact = one-hot-matmul LUT dequant, bitwise-identical "
-                "to float32 storage; auto = scale; off = always float32",
+                "traffic; 8-bit recoverability verified bitwise at build "
+                "time); off = always float32.  Which dequant kernel runs "
+                "in-step is --dequant_impl's decision",
+    "dequant_impl": "auto | affine | onehot | lut | pallas — in-step "
+                    "dequant kernel for quantized splits. auto = fused "
+                    "affine (u8 * scale + bias, one fused multiply-add) "
+                    "when it reproduces the 256-entry LUT bitwise "
+                    "(verified per split at quantize time; true for the "
+                    "MNIST/CIFAR loader specs — measured 4.1x over the "
+                    "round-4 LUT gather on chip), else one-hot-matmul "
+                    "LUT (bitwise on any backend). lut = elementwise "
+                    "gather diagnostic (the known-slow round-4 form); "
+                    "pallas = fused Pallas gather+dequant kernel "
+                    "(replicated device_data only)",
     "data_sharding": "replicated | sharded — sharded stores the resident "
                      "split row-wise across the mesh (per-device HBM "
                      "divided by mesh size; shuffling becomes per-shard, "

@@ -55,9 +55,11 @@ def _selector_apply(padded: jnp.ndarray, R: jnp.ndarray,
                       preferred_element_type=jnp.float32)
 
 
-def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
-    """[B, H, W, C] uint8 or float → same shape+dtype, randomly cropped +
-    flipped (pure pixel rearrangement, bitwise-exact for both dtypes)."""
+def _crop_flip_selectors(images: jnp.ndarray, key: jax.Array):
+    """(padded, R, C): the reflect-padded input plus the per-image one-hot
+    row/column selectors encoding a random crop + hflip draw — the shared
+    front half of both augment entry points, so the fused dequant variant
+    below draws EXACTLY the same crops/flips as the plain one."""
     b, h, w, c = images.shape
     ky, kx, kf = jax.random.split(key, 3)
     ys = jax.random.randint(ky, (b,), 0, 2 * PAD + 1)
@@ -76,6 +78,14 @@ def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
     k = jnp.arange(w)[None, None, :]
     src = jnp.where(flips[:, None, None], w - 1 - k, k) + xs[:, None, None]
     C = (jnp.arange(hp)[None, :, None] == src).astype(md)
+    return padded, R, C
+
+
+def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """[B, H, W, C] uint8 or float → same shape+dtype, randomly cropped +
+    flipped (pure pixel rearrangement, bitwise-exact for both dtypes)."""
+    padded, R, C = _crop_flip_selectors(images, key)
+    md = R.dtype
 
     if images.dtype == jnp.uint8:
         out = _selector_apply(padded.astype(md), R, C)
@@ -87,3 +97,34 @@ def cifar_augment_device(images: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
     out = (_selector_apply(hi, R, C) + _selector_apply(mid, R, C)
            ) + _selector_apply(lo, R, C)
     return out.astype(images.dtype)
+
+
+def cifar_augment_dequant_device(images: jnp.ndarray, key: jax.Array,
+                                 scale: jnp.ndarray,
+                                 bias: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, C] uint8 → float32: random crop + hflip AND the affine
+    dequant (``f32(u) * scale + bias``, constants from the data pytree's
+    ``dq_scale``/``dq_bias``) in ONE pass — the round-5 input-share fix
+    for the augmented path.
+
+    The plain route (``cifar_augment_device`` then dequant) materializes
+    an augmented uint8 batch between the two: the selector matmuls
+    accumulate in f32, cast BACK to uint8, and the dequant re-reads and
+    re-converts it.  Here the selectors' f32 output (exact — every output
+    pixel's dot has one nonzero term, and bytes are exact in bf16) feeds
+    the affine directly, so XLA fuses crop/flip/dequant into the selector
+    matmuls' epilogue: no uint8 intermediate, one fewer elementwise pass
+    over the batch.  Bitwise-identical to augment-then-dequant: the
+    routed f32 values ARE the byte values, so the affine sees the same
+    inputs either way (same crops/flips too — ``_crop_flip_selectors`` is
+    shared)."""
+    if images.dtype != jnp.uint8:
+        raise TypeError(f"cifar_augment_dequant_device fuses the uint8 "
+                        f"dequant; got {images.dtype} (use "
+                        f"cifar_augment_device)")
+    padded, R, C = _crop_flip_selectors(images, key)
+    out = _selector_apply(padded.astype(R.dtype), R, C)
+    # out[b,r,k,c] holds the exact routed byte value in f32; scale/bias
+    # are [1] or [C] and broadcast over the trailing channel axis — the
+    # same fused multiply-add apply_dequant_affine computes.
+    return out * scale + bias

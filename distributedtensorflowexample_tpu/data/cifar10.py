@@ -25,9 +25,13 @@ _SYNTH_SIZES = {"train": 50000, "test": 10000}
 
 def _to_nhwc(chw_rows: np.ndarray) -> np.ndarray:
     """[N, 3072] uint8 CHW rows -> [N,32,32,3] float32 in [0,1] — the ONE
-    conversion every layout path (pickle dir, binary, tar) must share."""
+    conversion every layout path (pickle dir, binary, tar) must share.
+    Multiplies by the canonical f32 1/255 (the repo-wide affine
+    byte->float convention, data.dequant), not an f32 division, so the
+    uint8-resident fast path dequantizes to these exact bits."""
+    from distributedtensorflowexample_tpu.data.dequant import U8_UNIT_SCALE
     nhwc = chw_rows.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-    return nhwc.astype(np.float32) / 255.0
+    return nhwc.astype(np.float32) * U8_UNIT_SCALE
 
 
 def _load_from_tar(data_dir: str, split: str):
@@ -138,7 +142,29 @@ def load_cifar10(data_dir: str, split: str = "train",
                                 sample_seed=seed * 2 + (1 if split == "train" else 2))
     images, labels = loaded
     if normalize:
-        images = (images - CIFAR10_MEAN) / CIFAR10_STD
+        # The FUSED affine form of (x - MEAN) / STD, applied to the
+        # recovered bytes with one rounding (data.dequant is the single
+        # definition of this arithmetic): bitwise-identical to what the
+        # in-step affine dequant of the uint8-resident split computes, so
+        # quantized and float-resident training agree bit for bit.  Every
+        # source above is byte-derived ([0,1] floats on the u/255 grid),
+        # so the rint recovery is exact — VERIFIED chunk-by-chunk below,
+        # not assumed: a future non-byte source (interpolation, padding,
+        # a pre-scaled array) must fail loudly here, never be silently
+        # snapped to the 8-bit grid.
+        from distributedtensorflowexample_tpu.data.dequant import (
+            affine_numpy, dequant_numpy)
+        out = np.empty(images.shape, np.float32)
+        for i in range(0, len(images), 4096):   # bounded transients, like
+            c = images[i:i + 4096]              # try_quantize
+            u8 = np.rint(np.clip(c, 0.0, 1.0) * 255.0).astype(np.uint8)
+            if not np.array_equal(dequant_numpy(u8, "unit"), c):
+                raise ValueError(
+                    "load_cifar10(normalize=True) expects byte-derived "
+                    "[0,1] pixels (u/255 grid); got values off the grid "
+                    "— normalize them upstream instead")
+            out[i:i + 4096] = affine_numpy(u8, "cifar")
+        images = out
     return images, labels
 
 

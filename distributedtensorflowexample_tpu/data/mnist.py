@@ -15,6 +15,7 @@ import struct
 
 import numpy as np
 
+from distributedtensorflowexample_tpu.data.dequant import U8_UNIT_SCALE
 from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
 
 _FILES = {
@@ -40,7 +41,12 @@ def _read_idx_images(path: str) -> np.ndarray:
     if magic != 2051:
         raise ValueError(f"bad IDX image magic {magic} in {path}")
     data = np.frombuffer(raw, dtype=np.uint8, count=n * rows * cols, offset=16)
-    return data.reshape(n, rows, cols, 1).astype(np.float32) / 255.0
+    # Multiply by the canonical f32 1/255, NOT divide: the affine form is
+    # the repo-wide byte->float convention (data.dequant), so the in-step
+    # affine dequant of the uint8-resident split is bitwise-identical to
+    # these floats.  (An f32 division rounds differently on 126/256 byte
+    # values — it was what forced the 4.1x-slower LUT dequant.)
+    return data.reshape(n, rows, cols, 1).astype(np.float32) * U8_UNIT_SCALE
 
 
 def _read_idx_labels(path: str) -> np.ndarray:

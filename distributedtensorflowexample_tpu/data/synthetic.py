@@ -52,9 +52,11 @@ def make_synthetic(num: int, shape: tuple[int, ...], num_classes: int,
     eps = srng.rand(num, *shape).astype(np.float32)
     images = (1.0 - noise) * templates[labels] + noise * eps
     images = np.clip(images, 0.0, 1.0)
-    # Snap pixels to the 8-bit grid (u/255), like every real image source:
-    # keeps the distribution learnable AND lets DeviceDataset store the
-    # split as uint8 in HBM (4x less gather traffic per training step —
-    # see DeviceDataset quantize docs).
-    images = (np.rint(images * 255.0).astype(np.float32) / 255.0)
+    # Snap pixels to the 8-bit grid (u * 1/255 — the canonical affine
+    # byte->float convention, data.dequant), like every real image
+    # source: keeps the distribution learnable AND lets DeviceDataset
+    # store the split as uint8 in HBM (4x less gather traffic per
+    # training step — see DeviceDataset quantize docs).
+    from distributedtensorflowexample_tpu.data.dequant import U8_UNIT_SCALE
+    images = np.rint(images * 255.0).astype(np.float32) * U8_UNIT_SCALE
     return images, labels

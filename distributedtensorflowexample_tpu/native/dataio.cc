@@ -63,9 +63,14 @@ int idx_images_parse(const unsigned char* buf, size_t len, float* out) {
   if (rc) return rc;
   const unsigned char* px = buf + 16;
   const int64_t total = n * rows * cols;
-  // Divide (not multiply-by-reciprocal): bit-identical to numpy's /255.
+  // Multiply by the rounded f32 reciprocal (data/dequant.py
+  // U8_UNIT_SCALE): the repo-wide canonical byte->float arithmetic —
+  // bit-identical to the numpy loader AND to the in-step affine dequant
+  // of a uint8-resident split.  A division would round differently on
+  // 126 of the 256 byte values.
+  const float kScale = 1.0f / 255.0f;  // constant-folded to the f32 value
 #pragma omp parallel for schedule(static)
-  for (int64_t i = 0; i < total; ++i) out[i] = float(px[i]) / 255.0f;
+  for (int64_t i = 0; i < total; ++i) out[i] = float(px[i]) * kScale;
   return 0;
 }
 
@@ -93,6 +98,7 @@ int cifar_parse(const unsigned char* buf, size_t len, float* out_images,
                 int32_t* out_labels) {
   if (len % 3073 != 0) return 1;
   const int64_t n = int64_t(len / 3073);
+  const float kScale = 1.0f / 255.0f;  // canonical affine scale (see above)
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     const unsigned char* rec = buf + i * 3073;
@@ -102,7 +108,7 @@ int cifar_parse(const unsigned char* buf, size_t len, float* out_images,
     for (int64_t y = 0; y < 32; ++y)
       for (int64_t x = 0; x < 32; ++x)
         for (int64_t c = 0; c < 3; ++c)
-          img[(y * 32 + x) * 3 + c] = float(chw[c * 1024 + y * 32 + x]) / 255.0f;
+          img[(y * 32 + x) * 3 + c] = float(chw[c * 1024 + y * 32 + x]) * kScale;
   }
   return 0;
 }

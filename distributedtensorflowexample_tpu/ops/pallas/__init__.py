@@ -2,23 +2,28 @@
 
 The reference's hot-path math lived in library native code (cuDNN kernels,
 TF C++ executor — SURVEY.md §2 "native dependency" table).  Our TPU-native
-equivalents are mostly XLA-compiled jnp, but the two ops XLA's fusion
-touches every step — the loss head and the optimizer update — also ship as
-hand-written Pallas kernels: single VMEM pass, no HBM round-trips between
-the fused stages, selectable per run (``RunConfig.pallas_ce`` for the loss
-head, ``RunConfig.fused_optimizer`` for the update).
+equivalents are mostly XLA-compiled jnp, but the ops XLA's fusion touches
+every step — the loss head, the optimizer update, and the input-path
+row gather — also ship as hand-written Pallas kernels: single VMEM pass,
+no HBM round-trips between the fused stages, selectable per run
+(``RunConfig.pallas_ce`` for the loss head, ``RunConfig.fused_optimizer``
+for the update, ``RunConfig.dequant_impl="pallas"`` for the fused
+gather+dequant of a uint8-resident split).
 
-Both kernels run in interpret mode on CPU, so the same code path is
+All kernels run in interpret mode on CPU, so the same code path is
 unit-testable without a TPU (SURVEY.md §4 test strategy).
 """
 
 from distributedtensorflowexample_tpu.ops.pallas.cross_entropy import (
     fused_softmax_cross_entropy_rows)
+from distributedtensorflowexample_tpu.ops.pallas.dequant import (
+    fused_gather_dequant)
 from distributedtensorflowexample_tpu.ops.pallas.sgd import (
     fused_momentum_sgd, fused_sgd_apply)
 
 __all__ = [
     "fused_softmax_cross_entropy_rows",
+    "fused_gather_dequant",
     "fused_momentum_sgd",
     "fused_sgd_apply",
 ]

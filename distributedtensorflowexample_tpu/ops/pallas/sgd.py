@@ -151,7 +151,9 @@ def fused_momentum_sgd(learning_rate, momentum: float = 0.9, mesh=None):
         interpret = _auto_interpret(None)
         if mesh is not None and mesh.size > 1:
             from jax.sharding import PartitionSpec as P
-            apply = jax.shard_map(
+
+            from distributedtensorflowexample_tpu.compat import shard_map
+            apply = shard_map(
                 lambda p, m, g, lr_: fused_sgd_flat(p, m, g, lr_, momentum,
                                                     interpret),
                 mesh=mesh, in_specs=(P(), P(), P(), P()),

@@ -241,7 +241,8 @@ def _build_shard_map_step(num_workers: int, period: int,
                     correct / total)
 
         wspec = P(DATA_AXIS)
-        body = jax.shard_map(
+        from distributedtensorflowexample_tpu.compat import shard_map
+        body = shard_map(
             shard_body, mesh=mesh,
             in_specs=(P(), wspec, wspec, wspec, wspec, wspec, wspec),
             out_specs=(wspec, wspec, wspec, P(), P()), check_vma=False)
@@ -257,18 +258,23 @@ def _build_shard_map_step(num_workers: int, period: int,
 
 def make_async_train_step(num_workers: int, period: int,
                           label_smoothing: float = 0.0, ce_impl: str = "xla",
-                          mesh=None, dequant: str | None = None) -> Callable:
+                          mesh=None, dequant: str | None = None,
+                          dequant_impl: str = "auto",
+                          quantize: str = "auto") -> Callable:
     """Build the jitted host-fed local-SGD step over worker-tiled state.
 
-    ``dequant``: spec for host-fed uint8 batches (``batcher.dequant``,
-    see sync.dequant_host_batch)."""
+    ``dequant``: spec for host-fed uint8 batches (``batcher.dequant``);
+    ``dequant_impl``/``quantize``: the in-step dequant kernel knobs,
+    resolved by the same rule as every other path (see
+    sync.dequant_host_batch)."""
     from distributedtensorflowexample_tpu.parallel.sync import (
         dequant_host_batch)
     inner = _build_async_step_fn(num_workers, period, label_smoothing,
                                  ce_impl, mesh)
 
     def step(state: TrainState, batch):
-        return inner(state, dequant_host_batch(batch, dequant))
+        return inner(state, dequant_host_batch(batch, dequant, dequant_impl,
+                                               quantize))
 
     return jax.jit(step, donate_argnums=0)
 
@@ -280,8 +286,8 @@ def make_indexed_async_train_step(num_workers: int, period: int,
                                   unroll_steps: int = 1,
                                   augment: str = "none",
                                   num_slots: int | None = None,
-                                  data_sharding: str = "replicated"
-                                  ) -> Callable:
+                                  data_sharding: str = "replicated",
+                                  dequant_impl: str = "auto") -> Callable:
     """Local-SGD step over a device-resident dataset — async's analog of
     ``sync.make_indexed_train_step``: same on-device gather from the
     perm ring (multi-epoch fused windows supported), same ``lax.scan``
@@ -295,7 +301,8 @@ def make_indexed_async_train_step(num_workers: int, period: int,
                                  ce_impl, mesh)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
                                 num_slots=num_slots,
-                                data_sharding=data_sharding)
+                                data_sharding=data_sharding,
+                                dequant_impl=dequant_impl)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))

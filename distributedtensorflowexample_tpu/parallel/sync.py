@@ -50,9 +50,10 @@ def make_loss_rows(label_smoothing: float = 0.0, ce_impl: str = "xla",
                                                           label_smoothing)
     if mesh is not None and mesh.size > 1:
         from jax.sharding import PartitionSpec as P
-        fused = jax.shard_map(fused, mesh=mesh,
-                              in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-                              out_specs=P(DATA_AXIS), check_vma=False)
+        from distributedtensorflowexample_tpu.compat import shard_map
+        fused = shard_map(fused, mesh=mesh,
+                          in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                          out_specs=P(DATA_AXIS), check_vma=False)
     return fused
 
 
@@ -74,10 +75,47 @@ def _resolve_num_slots(unroll_steps: int, steps_per_epoch: int,
     return num_slots
 
 
+def _dequant_gathered(img, data, dequant_impl: str):
+    """Dequantize a gathered uint8 batch: the ONE dispatch both indexed
+    gathers share.  The constants ride in the data pytree (affine/pallas
+    datasets carry ``dq_scale``/``dq_bias``, LUT-family datasets carry
+    the 256-entry ``lut``), so which family runs is static at trace time
+    and no call site can silently train on raw bytes; ``dequant_impl``
+    only refines WITHIN the LUT family (one-hot matmul vs the
+    known-slow elementwise gather diagnostic) and catches a
+    factory/dataset mismatch as a trace-time error instead of a wrong
+    kernel."""
+    if img.dtype != jnp.uint8:
+        return img
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        apply_dequant_affine, apply_dequant_gather, apply_dequant_lut)
+    if "dq_scale" in data:
+        if dequant_impl in ("onehot", "lut"):
+            raise ValueError(
+                f"step factory asked for dequant_impl={dequant_impl!r} but "
+                f"the dataset resolved to the affine family (it carries "
+                f"dq_scale/dq_bias) — pass the same dequant_impl to "
+                f"DeviceDataset and the step factory")
+        return apply_dequant_affine(img, data["dq_scale"], data["dq_bias"])
+    if "lut" in data:
+        if dequant_impl in ("affine", "pallas"):
+            raise ValueError(
+                f"step factory asked for dequant_impl={dequant_impl!r} but "
+                f"the dataset resolved to the LUT family (it carries lut) "
+                f"— pass the same dequant_impl to DeviceDataset and the "
+                f"step factory")
+        if dequant_impl == "lut":
+            return apply_dequant_gather(img, data["lut"])
+        return apply_dequant_lut(img, data["lut"])
+    raise TypeError("gathered batch is uint8 but the data pytree carries "
+                    "no dequant constants (not a DeviceDataset product?)")
+
+
 def make_device_gather(batch_size: int, steps_per_epoch: int,
                        augment: str = "none", mesh=None, *,
                        num_slots: int,
-                       data_sharding: str = "replicated") -> Callable:
+                       data_sharding: str = "replicated",
+                       dequant_impl: str = "auto") -> Callable:
     """(step, rng, data) -> batch: the on-device minibatch gather from a
     resident split (see ``data.DeviceDataset``), shared by the sync and
     async indexed step builders.  ``num_slots`` must equal the dataset's
@@ -85,11 +123,15 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
 
     A uint8-resident split (4x less gather traffic) dequantizes on the
     gathered batch only: the dequant constants ride in the data pytree
-    (``data["lut"]`` for the exact one-hot-matmul path,
-    ``data["dq_scale"]/["dq_bias"]`` for the fused affine path) and the
-    dispatch is on the pytree structure (static at trace time), so
-    quantization needs NO step-factory plumbing and no call site can
-    silently train on raw bytes.
+    and the dispatch is on the pytree structure (static at trace time),
+    so quantization needs NO step-factory plumbing and no call site can
+    silently train on raw bytes.  ``dequant_impl`` mirrors the dataset's
+    knob (``data.device_dataset.DEQUANT_IMPLS``): ``auto`` follows the
+    pytree (the affine fast path for both shipped loader specs);
+    ``pallas`` fuses the row gather and the affine dequant into ONE
+    kernel pass (ops/pallas/dequant.py — replicated datasets only);
+    ``lut`` forces the elementwise-gather diagnostic the bench uses to
+    keep the round-5 dequant tax attested.
 
     ``data_sharding="sharded"`` pairs with a row-sharded
     ``DeviceDataset(data_sharding="sharded")``: each device gathers its
@@ -102,11 +144,21 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
         raise ValueError(f"unknown augment {augment!r}")
     if data_sharding not in ("replicated", "sharded"):
         raise ValueError(f"unknown data_sharding {data_sharding!r}")
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        DEQUANT_IMPLS)
+    if dequant_impl not in DEQUANT_IMPLS:
+        raise ValueError(f"unknown dequant_impl {dequant_impl!r} "
+                         f"(one of {DEQUANT_IMPLS})")
     if data_sharding == "sharded":
         if mesh is None:
             raise ValueError("data_sharding='sharded' requires a mesh")
+        if dequant_impl == "pallas":
+            raise ValueError(
+                "dequant_impl='pallas' fuses the gather over the WHOLE "
+                "resident split; pair it with data_sharding='replicated'")
         return _make_sharded_gather(batch_size, steps_per_epoch, augment,
-                                    mesh, num_slots=num_slots)
+                                    mesh, num_slots=num_slots,
+                                    dequant_impl=dequant_impl)
 
     def gather(step, rng, data):
         # In-epoch position from the global step; modulo first so the
@@ -116,27 +168,52 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
         pos = (step % steps_per_epoch) * batch_size
         idx = jax.lax.dynamic_slice(data["perm"], (slot, pos),
                                     (1, batch_size))[0]
-        img = jnp.take(data["images"], idx, axis=0)
-        if augment == "cifar":
-            # On-device crop/flip (data/augment_device.py): a dedicated
-            # stream folded from the state rng — disjoint from the
-            # dropout stream, which folds in only the step.  Runs BEFORE
-            # dequantization: crop/flip only rearranges pixels, so it
-            # commutes bitwise with the elementwise LUT, and on a uint8-
-            # resident split any materialized pad/crop intermediate is
-            # 4x smaller.
-            from distributedtensorflowexample_tpu.data.augment_device import (
-                cifar_augment_device)
-            akey = jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), step)
-            img = cifar_augment_device(img, akey)
-        if img.dtype == jnp.uint8:
-            from distributedtensorflowexample_tpu.data.device_dataset import (
-                apply_dequant_affine, apply_dequant_lut)
-            if "lut" in data:
-                img = apply_dequant_lut(img, data["lut"])
-            else:
-                img = apply_dequant_affine(img, data["dq_scale"],
-                                           data["dq_bias"])
+        if dequant_impl == "pallas" and "dq_scale" in data:
+            # Fused row-gather + affine dequant: uint8 rows leave HBM
+            # once and arrive as the float32 batch — no materialized u8
+            # minibatch, no second dequant pass (VERDICT r4 #3, the
+            # profile-chosen kernel).  Augment (if any) runs after, on
+            # f32 — bitwise-commutable, the selectors route exactly.
+            from distributedtensorflowexample_tpu.ops.pallas import (
+                fused_gather_dequant)
+            img = fused_gather_dequant(data["images"], idx,
+                                       data["dq_scale"], data["dq_bias"])
+            if augment == "cifar":
+                from distributedtensorflowexample_tpu.data.augment_device import (
+                    cifar_augment_device)
+                akey = jax.random.fold_in(
+                    jax.random.fold_in(rng, 0x5EED), step)
+                img = cifar_augment_device(img, akey)
+        else:
+            img = jnp.take(data["images"], idx, axis=0)
+            if augment == "cifar":
+                # On-device crop/flip (data/augment_device.py): a
+                # dedicated stream folded from the state rng — disjoint
+                # from the dropout stream, which folds in only the step.
+                # Runs BEFORE dequantization: crop/flip only rearranges
+                # pixels, so it commutes bitwise with the elementwise
+                # dequant, and on a uint8-resident split any materialized
+                # pad/crop intermediate is 4x smaller.  On the affine
+                # path the dequant is FUSED into the selector matmuls'
+                # f32 output (one pass, no u8 cast-back — the round-5
+                # ResNet input-share fix).
+                akey = jax.random.fold_in(
+                    jax.random.fold_in(rng, 0x5EED), step)
+                # Forced LUT-family impls skip the fused form so the
+                # dequant below runs the kernel the caller named (or
+                # raises the family mismatch) instead of silently
+                # measuring affine.
+                if (img.dtype == jnp.uint8 and "dq_scale" in data
+                        and dequant_impl not in ("onehot", "lut")):
+                    from distributedtensorflowexample_tpu.data.augment_device import (
+                        cifar_augment_dequant_device)
+                    img = cifar_augment_dequant_device(
+                        img, akey, data["dq_scale"], data["dq_bias"])
+                else:
+                    from distributedtensorflowexample_tpu.data.augment_device import (
+                        cifar_augment_device)
+                    img = cifar_augment_device(img, akey)
+            img = _dequant_gathered(img, data, dequant_impl)
         batch = {"image": img,
                  "label": jnp.take(data["labels"], idx, axis=0)}
         if mesh is not None and mesh.size > 1:
@@ -154,7 +231,8 @@ def make_device_gather(batch_size: int, steps_per_epoch: int,
 
 
 def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
-                         augment: str, mesh, *, num_slots: int) -> Callable:
+                         augment: str, mesh, *, num_slots: int,
+                         dequant_impl: str = "auto") -> Callable:
     """The ``data_sharding="sharded"`` gather (see ``make_device_gather``):
     runs under ``shard_map`` over the data axis, each device slicing its
     bpd positions out of the (replicated) perm ring and translating them
@@ -179,23 +257,32 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
             idx = jax.lax.dynamic_slice(perm, (slot, pos), (1, bpd))[0]
             idx = idx - d * rows                # global -> local row space
             img = jnp.take(images, idx, axis=0)
+            dq_data = ({"lut": dq[0]} if has_lut else
+                       {"dq_scale": dq[0], "dq_bias": dq[1]} if has_affine
+                       else {})
             if augment == "cifar":
                 # Same stream layout as the replicated gather, plus the
                 # device index: each shard draws independent crops/flips
                 # (same distribution; draws differ from replicated mode).
-                from distributedtensorflowexample_tpu.data.augment_device import (
-                    cifar_augment_device)
                 akey = jax.random.fold_in(
                     jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), step),
                     d)
-                img = cifar_augment_device(img, akey)
-            if img.dtype == jnp.uint8:
-                from distributedtensorflowexample_tpu.data.device_dataset import (
-                    apply_dequant_affine, apply_dequant_lut)
-                if has_lut:
-                    img = apply_dequant_lut(img, dq[0])
+                if (img.dtype == jnp.uint8 and has_affine
+                        and dequant_impl not in ("onehot", "lut")):
+                    # Affine dequant fused into the selector matmuls'
+                    # f32 output — same one-pass form as the replicated
+                    # gather (see make_device_gather); a forced LUT-
+                    # family impl takes the plain route so the dequant
+                    # below runs (or rejects) the named kernel.
+                    from distributedtensorflowexample_tpu.data.augment_device import (
+                        cifar_augment_dequant_device)
+                    img = cifar_augment_dequant_device(img, akey,
+                                                       dq[0], dq[1])
                 else:
-                    img = apply_dequant_affine(img, dq[0], dq[1])
+                    from distributedtensorflowexample_tpu.data.augment_device import (
+                        cifar_augment_device)
+                    img = cifar_augment_device(img, akey)
+            img = _dequant_gathered(img, dq_data, dequant_impl)
             return img, jnp.take(labels, idx, axis=0)
 
         args = [step, rng, data["images"], data["labels"], data["perm"]]
@@ -206,7 +293,8 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
         elif has_affine:
             args.extend([data["dq_scale"], data["dq_bias"]])
             in_specs.extend([P(), P()])
-        img, lab = jax.shard_map(
+        from distributedtensorflowexample_tpu.compat import shard_map
+        img, lab = shard_map(
             local, mesh=mesh, in_specs=tuple(in_specs),
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False)(*args)
         return {"image": img, "label": lab}
@@ -290,13 +378,19 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
     return step
 
 
-def dequant_host_batch(batch, dequant: str | None):
-    """Dequantize a HOST-FED uint8 batch in-step through a LUT closure
-    constant (``data.device_dataset.make_dequant_lut`` — 4x less H2D
-    per step than uploading float32).  Float batches pass through.  A
-    uint8 batch with no spec is a TRACE-TIME error: silently training
-    on raw 0-255 bytes is the failure this guard exists to prevent —
-    pass ``dequant=batcher.dequant`` (``data.pipeline.Batcher``)."""
+def dequant_host_batch(batch, dequant: str | None,
+                       dequant_impl: str = "auto", quantize: str = "auto"):
+    """Dequantize a HOST-FED uint8 batch in-step (4x less H2D per step
+    than uploading float32).  Float batches pass through.  A uint8 batch
+    with no spec is a TRACE-TIME error: silently training on raw 0-255
+    bytes is the failure this guard exists to prevent — pass
+    ``dequant=batcher.dequant`` (``data.pipeline.Batcher``).
+
+    ``dequant_impl`` resolves through the SAME rule as the resident path
+    (``data.device_dataset.resolve_dequant_impl``), so host-fed and
+    resident training dequantize through the same kernel — the affine
+    fast path for both shipped loader specs.  ``pallas`` degenerates to
+    affine here: there is no gather to fuse with on an uploaded batch."""
     img = batch["image"]
     if img.dtype != jnp.uint8:
         return batch
@@ -305,23 +399,33 @@ def dequant_host_batch(batch, dequant: str | None):
             "host-fed batch images are uint8 but the train step was "
             "built without dequant=; pass dequant=batcher.dequant")
     from distributedtensorflowexample_tpu.data.device_dataset import (
-        dequantize_images)
-    return dict(batch, image=dequantize_images(img, dequant))
+        dequantize_images, resolve_dequant_impl)
+    # quantize travels too: the rule's speed-over-bits escape for
+    # non-affine-representable specs (quantize="scale") must resolve
+    # identically here and on the resident path.
+    impl = resolve_dequant_impl(dequant, dequant_impl, quantize)
+    impl = "affine" if impl == "pallas" else impl
+    return dict(batch, image=dequantize_images(img, dequant, impl))
 
 
 def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
                     mesh=None, num_replicas: int = 1,
                     replicas_to_aggregate: int = 0,
-                    dequant: str | None = None) -> Callable:
+                    dequant: str | None = None,
+                    dequant_impl: str = "auto",
+                    quantize: str = "auto") -> Callable:
     """Build the jitted (state, batch) -> (state, metrics) step.
 
     ``dequant``: spec for HOST-FED uint8 batches (``batcher.dequant``);
-    the resident/indexed path dequantizes in its gather instead."""
+    the resident/indexed path dequantizes in its gather instead.
+    ``dequant_impl``/``quantize``: the in-step dequant kernel knobs (same
+    resolution rule as the resident path — see ``dequant_host_batch``)."""
     inner = _build_step_fn(label_smoothing, ce_impl, mesh,
                            num_replicas, replicas_to_aggregate)
 
     def step(state: TrainState, batch):
-        return inner(state, dequant_host_batch(batch, dequant))
+        return inner(state, dequant_host_batch(batch, dequant, dequant_impl,
+                                               quantize))
 
     return jax.jit(step, donate_argnums=0)
 
@@ -333,7 +437,8 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             augment: str = "none", num_replicas: int = 1,
                             replicas_to_aggregate: int = 0,
                             num_slots: int | None = None,
-                            data_sharding: str = "replicated") -> Callable:
+                            data_sharding: str = "replicated",
+                            dequant_impl: str = "auto") -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -367,7 +472,8 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                            replicas_to_aggregate)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
                                 num_slots=num_slots,
-                                data_sharding=data_sharding)
+                                data_sharding=data_sharding,
+                                dequant_impl=dequant_impl)
 
     def one(state: TrainState, data) -> tuple[TrainState, dict]:
         return inner(state, gather(state.step, state.rng, data))
@@ -411,7 +517,8 @@ def make_eval_step() -> Callable:
 
 
 def make_resident_eval(images, labels, batch_size: int = 1000,
-                       mesh=None, quantize: str = "auto") -> Callable:
+                       mesh=None, quantize: str = "auto",
+                       dequant_impl: str = "auto") -> Callable:
     """Device-resident exact-accuracy eval: ONE dispatch per eval.
 
     The host-fed ``evaluate`` re-uploads the split 1000 rows at a time on
@@ -422,29 +529,30 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     batch row-wise over the mesh, and jits a ``lax.scan`` over the batches
     — the whole eval is a single compiled call returning one scalar.
     Like the train split, a quantizable split is held as uint8 (4x less
-    HBM + upload) and dequantized in the scan body.  ``quantize``
-    mirrors the train-path flag: ``"off"`` keeps the split
-    float32-resident, ``"exact"`` dequantizes bitwise through the LUT
-    (``data.device_dataset.dequantize_images``), ``"scale"``/``"auto"``
-    use the fused affine form (~1 ulp, fastest — see
-    ``make_dequant_affine``).
+    HBM + upload) and dequantized in the scan body.  ``quantize`` and
+    ``dequant_impl`` mirror the train-path flags and resolve through the
+    SAME rule (``data.device_dataset.resolve_dequant_impl``), so a
+    bitwise train/eval parity check exercises one kernel, not two
+    (``pallas`` degenerates to affine here: the scan slices resident
+    batches, there is no row gather to fuse).
 
     Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
     """
     import numpy as np
 
     from distributedtensorflowexample_tpu.data.device_dataset import (
-        _try_quantize, apply_dequant_affine, dequantize_images,
-        make_dequant_affine)
+        _try_quantize, dequantize_images, resolve_dequant_impl)
 
     if quantize not in ("auto", "off", "exact", "scale"):
         raise ValueError(f"unknown quantize mode {quantize!r}")
-    mode = "scale" if quantize == "auto" else quantize
     dequant = None
-    if mode in ("scale", "exact"):
+    if quantize != "off":
         q = _try_quantize(np.asarray(images))
         if q is not None:
             images, dequant = q
+    impl = (resolve_dequant_impl(dequant, dequant_impl, quantize)
+            if dequant is not None else None)
+    impl = "affine" if impl == "pallas" else impl
 
     n = len(labels)
     if mesh is not None and batch_size % mesh.size:
@@ -486,12 +594,7 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
         def body(total, xy):
             bx, by = xy
             if dequant is not None:
-                if mode == "exact":
-                    bx = dequantize_images(bx, dequant)
-                else:
-                    s, b = make_dequant_affine(dequant)
-                    bx = apply_dequant_affine(bx, jnp.asarray(s),
-                                              jnp.asarray(b))
+                bx = dequantize_images(bx, dequant, impl)
             logits = state.apply_fn(variables, bx, train=False)
             correct = jnp.sum(
                 (jnp.argmax(logits, axis=-1) == by).astype(jnp.int32))

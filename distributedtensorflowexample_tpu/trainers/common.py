@@ -202,6 +202,16 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     if cfg.data_sharding == "sharded" and cfg.device_data == "off":
         raise ValueError("--data_sharding sharded requires the "
                          "device-resident input path (device_data)")
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        DEQUANT_IMPLS)
+    if cfg.dequant_impl not in DEQUANT_IMPLS:
+        raise ValueError(f"unknown dequant_impl {cfg.dequant_impl!r} "
+                         f"(one of {DEQUANT_IMPLS})")
+    if cfg.dequant_impl == "pallas" and (cfg.device_data == "off"
+                                         or cfg.data_sharding == "sharded"):
+        raise ValueError("--dequant_impl pallas fuses the on-device row "
+                         "gather with the dequant; it requires the "
+                         "replicated device-resident input path")
 
     train_x, train_y = _load_dataset(cfg, dataset_name, "train")
     test_x, test_y = _load_dataset(cfg, dataset_name, "test")
@@ -219,6 +229,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                           process_count=jax.process_count(),
                           augment_fn=cifar_augment if augment else None,
                           quantize=cfg.quantize)
+        # eval/train symmetry: the resident eval below resolves the SAME
+        # --dequant_impl; the host-fed steps resolve it in
+        # dequant_host_batch.
         batches = DevicePrefetcher(batcher, sharding=data_shard)
 
     model = build_model(model_name, dropout=cfg.dropout,
@@ -271,7 +284,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         # Test split resident in HBM too: one dispatch per eval, and eval
         # wall time stops polluting the training window.
         _evaluate = make_resident_eval(test_x, test_y, batch_size=eval_batch,
-                                       mesh=mesh, quantize=cfg.quantize)
+                                       mesh=mesh, quantize=cfg.quantize,
+                                       dequant_impl=cfg.dequant_impl)
     else:
         _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
                                       batch_size=eval_batch,
@@ -323,6 +337,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                            seed=cfg.seed, start_step=int(state.step),
                            steps_per_next=steps_per_call,
                            quantize=cfg.quantize,
+                           dequant_impl=cfg.dequant_impl,
                            data_sharding=cfg.data_sharding)
         batches = ds
     elif cfg.steps_per_loop > 1:
@@ -334,24 +349,30 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             num_replicas, cfg.async_period, global_batch, ds.steps_per_epoch,
             cfg.label_smoothing, ce_impl=ce_impl, mesh=mesh,
             unroll_steps=steps_per_call, augment=device_augment,
-            num_slots=ds.num_slots, data_sharding=cfg.data_sharding)
+            num_slots=ds.num_slots, data_sharding=cfg.data_sharding,
+            dequant_impl=cfg.dequant_impl)
     elif is_async:
         train_step = make_async_train_step(num_replicas, cfg.async_period,
                                            cfg.label_smoothing,
                                            ce_impl=ce_impl, mesh=mesh,
-                                           dequant=batcher.dequant)
+                                           dequant=batcher.dequant,
+                                           dequant_impl=cfg.dequant_impl,
+                                           quantize=cfg.quantize)
     elif use_device_data:
         train_step = make_indexed_train_step(
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
             ce_impl=ce_impl, mesh=mesh, unroll_steps=steps_per_call,
             augment=device_augment, num_replicas=num_replicas,
             replicas_to_aggregate=cfg.replicas_to_aggregate,
-            num_slots=ds.num_slots, data_sharding=cfg.data_sharding)
+            num_slots=ds.num_slots, data_sharding=cfg.data_sharding,
+            dequant_impl=cfg.dequant_impl)
     else:
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
                                      mesh=mesh, num_replicas=num_replicas,
                                      replicas_to_aggregate=cfg.replicas_to_aggregate,
-                                     dequant=batcher.dequant)
+                                     dequant=batcher.dequant,
+                                     dequant_impl=cfg.dequant_impl,
+                                     quantize=cfg.quantize)
     # Preemption safety (TPU-first failure recovery, SURVEY §5): the
     # platform sends SIGTERM before reclaiming a slice/VM.  The handler
     # only SETS A FLAG — the loop polls it at call boundaries and stops

@@ -37,6 +37,10 @@ class TrainLoop:
         the state is always the last completed step's."""
         self._train_step = train_step
         self._batches = batches
+        # Post-dispatch prefetch hook (DeviceDataset.prefetch): computes
+        # the NEXT window's epoch permutations while the just-enqueued
+        # step runs, so the dispatch boundary never waits on them.
+        self._prefetch = getattr(batches, "prefetch", None)
         self._num_steps = num_steps
         self._hooks = list(hooks)
         self._logger = logger or MetricsLogger()
@@ -58,6 +62,10 @@ class TrainLoop:
                 if self._should_stop is not None and self._should_stop():
                     break
                 state, metrics = self._train_step(state, next(self._batches))
+                if self._prefetch is not None:
+                    # AFTER the step dispatch: the perm updates enqueue
+                    # behind the in-flight step and overlap it.
+                    self._prefetch()
                 self._logger.maybe_log(step, metrics)
                 # Every hook sees every step (no short-circuit) — a stop
                 # request must not mask another hook's work at the same

@@ -30,16 +30,23 @@ import os
 # minutes), and the run_training-heavy files execute in isolated
 # subprocesses with abort-only retry (tests/test_isolated.py) so one
 # deadlock cannot kill the suite.
+import jax
+import pytest
+
+from distributedtensorflowexample_tpu.compat import (
+    cpu_collective_flags, enable_persistent_compilation_cache,
+    set_num_cpu_devices)
+
+# Version-gated through compat: 0.4.x jaxlibs don't know these names, and
+# an unknown name is itself the fatal abort described above.  Importing
+# jax before appending is safe — XLA_FLAGS is parsed at first BACKEND
+# INIT, not at import.
 if "--xla_cpu_collective_call" not in os.environ.get("XLA_FLAGS", ""):
     # idempotent: the isolated-subprocess inner runs inherit the outer
     # value and must not append duplicates
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-        + " --xla_cpu_collective_call_terminate_timeout_seconds=300")
-
-import jax
-import pytest
+        + cpu_collective_flags(warn_s=60, terminate_s=300))
 
 from isolation_list import ISOLATED_FILES
 
@@ -54,22 +61,37 @@ jax.config.update("jax_platforms", "cpu")
 # isolation wrapper retries an ABORTED inner run at 4 devices — same
 # mesh/psum/sharding code path, narrower rendezvous, which drops the
 # under-contention deadlock probability that caused the abort.
-jax.config.update("jax_num_cpu_devices",
-                  int(os.environ.get("DISTTF_TEST_DEVICES", "8")))
+# Through the compat shim: current jax has the jax_num_cpu_devices
+# config, the 0.4.x pin only honors the XLA force-host-device flag.
+set_num_cpu_devices(int(os.environ.get("DISTTF_TEST_DEVICES", "8")))
 # Persistent compilation cache: the suite is compile-dominated (dozens of
 # jit programs, recompiled from scratch in every isolated subprocess —
 # tests/test_isolated.py), and this 1-core host pays ~30-80 s per big
 # compile under load.  The cache is keyed by HLO+flags+topology, so the
 # 8-virtual-device programs hit across inner runs and across consecutive
-# suite runs.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DISTTF_JAX_CACHE", "/tmp/jax_cache_tests"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# suite runs.  VERSION-GATED through compat: on 0.4.x jaxlibs a
+# cache-loaded executable silently drops donated-argument write-backs
+# (BN stats come back unchanged from a hit), so there the helper is a
+# no-op and each process recompiles.
+enable_persistent_compilation_cache(
+    os.environ.get("DISTTF_JAX_CACHE", "/tmp/jax_cache_tests"))
 # Synchronous CPU dispatch: a deep async queue of collective programs
 # multiplies the concurrent-thread demand and with it the starvation
 # window.  Purely a test-environment knob — the TPU runtime throttles its
 # own queue.
 jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the isolated-subprocess wrappers (tests/test_isolated.py) LAST.
+    Each wrapper is a full pytest subprocess that recompiles every jit
+    program from scratch (no trustworthy persistent cache on the 0.4.x
+    pin — see compat.enable_persistent_compilation_cache), so on a loaded
+    1-core host they dominate wall time by minutes per file.  Running the
+    cheap inline tests first means a time-bounded suite run (the tier-1
+    harness kills at a fixed deadline) reports every fast test's verdict
+    instead of losing them behind a mid-alphabet compile stall."""
+    items.sort(key=lambda it: it.fspath.basename == "test_isolated.py")
 
 
 @pytest.fixture()

@@ -16,6 +16,7 @@ ISOLATED_FILES = [
     "test_async.py",
     "test_bench.py",        # bench_profile end-to-end = full ResNet pipeline
     "test_checkpoint.py",
+    "test_dequant.py",      # bitwise parity runs = fused training loops
     "test_determinism.py",
     "test_device_data.py",
     "test_sync_dp.py",

@@ -107,6 +107,130 @@ def test_sweep_fault_isolation(tiny_mnist):
     assert best == 0.0 and best_u is None and sweep == {} and "p_2" in errors
 
 
+def test_affine_dequant_not_slower_than_lut_gather():
+    """The round-5 regression guard, as a CPU microbench: the fused
+    affine dequant of a fixed headline-sized batch must not be slower
+    than the elementwise LUT gather it replaced (the round-4 default the
+    on-chip window measured at 4.1x the step time — AB_quantize_r05).  A
+    refactor that silently re-routes the default back through the gather
+    shows up here as a timing inversion, before it costs a TPU window.
+    CPU magnitudes differ from TPU but the ordering holds at this batch
+    shape on the per-channel spec (measured ~5x; 1.5x slack for CI
+    noise)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedtensorflowexample_tpu.data.dequant import (
+        make_dequant_affine, make_dequant_lut)
+    from distributedtensorflowexample_tpu.data.device_dataset import (
+        apply_dequant_affine, apply_dequant_gather)
+
+    u = jnp.asarray(np.random.RandomState(0).randint(
+        0, 256, (bench.BATCH["resnet"], 32, 32, 3), dtype=np.uint8))
+    s, b = (jnp.asarray(v) for v in make_dequant_affine("cifar"))
+    lut = jnp.asarray(make_dequant_lut("cifar"))
+    f_affine = jax.jit(lambda u: apply_dequant_affine(u, s, b))
+    f_gather = jax.jit(lambda u: apply_dequant_gather(u, lut))
+
+    def best_of(f, reps=7):
+        f(u).block_until_ready()           # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f(u).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_affine, t_gather = best_of(f_affine), best_of(f_gather)
+    # 3x slack: the regression this guards against is a ≥4x tax (the
+    # gather re-appearing in the fast path), and min-of-7 on the
+    # contended shared CI host still jitters — the deterministic
+    # no-256-gather jaxpr check in test_dequant.py catches structure;
+    # this one only has to catch a wholesale speed inversion.
+    assert t_affine <= t_gather * 3.0, (
+        f"affine dequant ({t_affine * 1e6:.0f}us) slower than the LUT "
+        f"gather ({t_gather * 1e6:.0f}us): the round-5 dequant tax is "
+        f"back — check the auto lowering in data.device_dataset")
+
+
+def test_dequant_ab_auto_selects_winning_impl(monkeypatch, capsys):
+    """--dequant auto promotes tools/ab_quantize.py's sweep into the
+    official record: the alternatives are measured at the winning unroll,
+    the fastest supersedes the resolved default (detail.dequant names
+    it), every alternative's repeats land in detail.dequant_ab, and the
+    promoted line re-probes its roofline in its own window."""
+    probes = []
+
+    class FakeDs:
+        def __init__(self, impl):
+            self.dequant_impl = impl
+
+    def fake_make(model, dataset, b, unroll, mesh, **kw):
+        impl = kw.get("dequant_impl", "auto")
+        if impl in bench.DEQUANT_AB_IMPLS:
+            return ("step", FakeDs(impl), "state", unroll)
+        raise RuntimeError("side workload down")   # sides fail fast
+
+    def fake_measure(step, ds, state, steps, u, warmup_calls=2):
+        rate = {"onehot": 60.0, "lut": 5.0, "pallas": 55.0}[ds.dequant_impl]
+        return rate, [rate], state
+
+    def fake_roofline(*a, **k):
+        probes.append(1)
+        return [80.0] if len(probes) == 1 else [120.0]
+
+    def fake_sweep(unrolls, make_fn, steps_for, err_prefix, errors):
+        if err_prefix != "sweep_":
+            return (0.0, None, [], {})      # resnet's sweep: fail
+        return (50.0, 16, [50.0], {"16": [50.0]})
+
+    monkeypatch.setattr(bench, "_sweep", fake_sweep)
+    monkeypatch.setattr(bench, "_make", fake_make)
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_roofline_probe", fake_roofline)
+
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    line = lines[-1]
+    assert line["metric"] == "mnist_cnn_sync_steps_per_sec_per_chip"
+    # onehot (60) beat the held default (50) and pallas (55): promoted.
+    assert line["value"] == round(60.0 / make_mesh().size, 2)
+    assert line["detail"]["dequant"] == "onehot"
+    assert line["detail"]["dequant_ab"] == {
+        "onehot": [60.0], "lut": [5.0], "pallas": [55.0]}
+    # Fresh same-window probe for the promoted line: 60/120 = 0.5.
+    assert line["detail"]["vs_roofline"] == 0.5
+    assert len(probes) == 2
+
+
+def test_dequant_forced_impl_skips_ab(monkeypatch, capsys):
+    """A named --dequant impl forces the kernel and runs NO A/B (each
+    alternative is a compile the operator asked to skip)."""
+    def fake_make(*a, **k):
+        raise RuntimeError("side workload down")
+
+    def fake_sweep(unrolls, make_fn, steps_for, err_prefix, errors):
+        if err_prefix != "sweep_":
+            return (0.0, None, [], {})
+        return (50.0, 16, [50.0], {"16": [50.0]})
+
+    monkeypatch.setattr(bench, "DEQUANT", "affine")
+    monkeypatch.setattr(bench, "_sweep", fake_sweep)
+    monkeypatch.setattr(bench, "_make", fake_make)
+    monkeypatch.setattr(bench, "_roofline_probe", lambda *a, **k: [100.0])
+
+    bench.main()
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    line = lines[-1]
+    assert line["unit"] == "steps/sec/chip"
+    assert "dequant_ab" not in line["detail"]
+    assert not any(k.startswith("dequant_ab") for k in
+                   line["detail"].get("errors", {}))
+
+
 def test_emit_shape(capsys):
     bench._emit("some_metric", 123.456, {"some_metric": 100.0},
                 {"repeats": [1.0]})
@@ -364,8 +488,7 @@ def test_watchdog_emits_held_headline_when_side_workload_wedges():
         "bench._make = lambda *a, **k: time.sleep(600)\n"
         "bench.main()\n"
     )
-    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
-               BENCH_FORCE_WATCHDOG="1")
+    env = _bench_subprocess_env(BENCH_FORCE_WATCHDOG="1")
     p = subprocess.run([sys.executable, "-c", code],
                        cwd=os.path.dirname(os.path.dirname(__file__)),
                        capture_output=True, text=True, timeout=120, env=env)
@@ -396,6 +519,24 @@ def test_watchdog_disarmed_on_completion():
     assert fired2 == [1] and exits2 == [3]
 
 
+def _bench_subprocess_env(**extra):
+    """Env for a real bench.main() subprocess: CPU-pinned, with any
+    device-count pin inherited from THIS pytest process stripped.  On
+    jax versions without the ``jax_num_cpu_devices`` config, conftest's
+    compat shim exports ``--xla_force_host_platform_device_count=8``
+    into ``XLA_FLAGS``, which a child would inherit — but these tests
+    model the driver's clean shell, where bench sees ONE cpu device
+    (the per-chip division then leaves the mocked rates unscaled)."""
+    import os
+
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               **extra)
+    flags = [t for t in env.get("XLA_FLAGS", "").split()
+             if not t.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 def _spawn_bench(extra_code: str):
     """Run the REAL bench.main() in a subprocess (CPU-pinned via
     jax.config, like the other subprocess tests) with ``extra_code``
@@ -409,7 +550,7 @@ def _spawn_bench(extra_code: str):
             "import jax\n"
             "jax.config.update('jax_platforms', 'cpu')\n"
             "import bench\n" + extra_code + "bench.main()\n")
-    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env = _bench_subprocess_env()
     return subprocess.Popen(
         [sys.executable, "-c", code],
         cwd=os.path.dirname(os.path.dirname(__file__)),

@@ -78,6 +78,10 @@ def test_bench_main_success_path(small_synthetic, monkeypatch, capsys,
                         {"headline": 8, "softmax": 8, "resnet": 4})
     monkeypatch.setattr(bench, "HEADLINE_REST_UNROLLS", lambda spe: {spe})
     monkeypatch.setattr(bench, "RESNET_UNROLLS", lambda spe: {spe})
+    # One A/B alternative (each impl is a fresh multi-minute compile
+    # here); the full impl set's selection logic is covered by the faked
+    # tests in test_bench.py.
+    monkeypatch.setattr(bench, "DEQUANT_AB_IMPLS", ("lut",))
 
     bench.main()
 
@@ -102,6 +106,12 @@ def test_bench_main_success_path(small_synthetic, monkeypatch, capsys,
     assert headline["detail"]["best_unroll"] is not None
     assert 0 < headline["detail"]["vs_roofline"]
     assert headline["detail"]["roofline_probe"]
+    # Dequant attestation (round-5 satellite): the record names the impl
+    # that ran (auto resolves to affine; the A/B may promote the thinned
+    # alternative on this noisy host — both attest a real measurement)
+    # and carries the measured alternative's rates.
+    assert headline["detail"]["dequant"] in ("affine", "lut")
+    assert list(headline["detail"]["dequant_ab"]) == ["lut"]
     # The success path must be clean — any per-workload error means a
     # real breakage the driver would hit.
     assert "errors" not in headline["detail"], headline["detail"]["errors"]

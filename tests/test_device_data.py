@@ -349,12 +349,15 @@ def test_no_truncation_and_unshuffled_order():
 
 
 def test_steps_per_next_bounds_and_ring_sizing():
+    # Ring sized for TWO consecutive windows (ceil(2K/spe) + 2): prefetch
+    # computes the next window's permutations while the current window is
+    # in flight — see DeviceDataset.ring_slots_for.
     x, y = _data(384)   # 6 steps/epoch at batch 64
     mesh = make_mesh()
-    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=6).num_slots == 3
-    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=7).num_slots == 4
+    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=6).num_slots == 4
+    assert DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=7).num_slots == 5
     assert DeviceDataset(x, y, 64, mesh=mesh,
-                         steps_per_next=24).num_slots == 6
+                         steps_per_next=24).num_slots == 10
     with pytest.raises(ValueError, match="steps_per_next"):
         DeviceDataset(x, y, 64, mesh=mesh, steps_per_next=0)
 
@@ -368,7 +371,7 @@ def test_multi_epoch_fused_window_matches_stepwise():
     b, K = 64, 15
     ds1 = DeviceDataset(x, y, b, mesh=mesh, seed=11)
     dsK = DeviceDataset(x, y, b, mesh=mesh, seed=11, steps_per_next=K)
-    assert dsK.num_slots == 5
+    assert dsK.num_slots == 7                  # two 15-step windows + margin
     make_state = lambda: TrainState.create_sharded(
         build_model("softmax"), optax.sgd(0.1), (b, 28, 28, 1), 0,
         replicated_sharding(mesh))
@@ -404,14 +407,19 @@ def test_auto_quantize_stores_uint8_and_dequant_is_bitwise():
 
     from distributedtensorflowexample_tpu.parallel.sync import (
         make_device_gather)
-    # No dequant plumbing: the LUT rides in the data pytree and the
-    # gather dtype-dispatches, so the same factory serves both.
+    # No dequant plumbing: the constants ride in the data pytree and the
+    # gather dtype-dispatches, so the same factory serves both.  The
+    # default impl resolves to the affine fast path (round 5), so the
+    # quantized pytree carries dq_scale/dq_bias, not a LUT.
     g_u = jax.jit(make_device_gather(64, ds.steps_per_epoch, mesh=mesh,
                                      num_slots=ds.num_slots))
     g_f = jax.jit(make_device_gather(64, ds_f.steps_per_epoch, mesh=mesh,
                                      num_slots=ds_f.num_slots))
-    assert "lut" in next(iter([ds.peek()]))  # quantized data carries it
-    assert "lut" not in ds_f.peek()
+    peeked = ds.peek()
+    assert "dq_scale" in peeked and "dq_bias" in peeked
+    assert "lut" not in peeked
+    peeked_f = ds_f.peek()
+    assert "lut" not in peeked_f and "dq_scale" not in peeked_f
     step0 = jnp.asarray(0, jnp.int32)
     rng = jax.random.PRNGKey(0)
     with mesh:
@@ -425,12 +433,14 @@ def test_auto_quantize_stores_uint8_and_dequant_is_bitwise():
 
 
 def test_auto_quantize_recovers_cifar_normalization():
-    from distributedtensorflowexample_tpu.data.cifar10 import (
-        CIFAR10_MEAN, CIFAR10_STD)
     from distributedtensorflowexample_tpu.data.device_dataset import (
         _dequant_numpy)
     x, y = make_synthetic(256, (32, 32, 3), 10, seed=1)
-    xn = (x - CIFAR10_MEAN) / CIFAR10_STD      # the loader's exact op order
+    # The loader's exact arithmetic (load_cifar10 normalize=True): recover
+    # the bytes and apply the canonical single-rounding affine — NOT a
+    # separate f32 (x - MEAN) / STD, which double-rounds off the affine
+    # grid and would (correctly) fail byte recovery.
+    xn = _dequant_numpy(np.rint(x * 255.0).astype(np.uint8), "cifar")
     ds = DeviceDataset(xn, y, 32, mesh=make_mesh())
     assert ds.dequant == "cifar"
     u8 = np.asarray(ds.images)

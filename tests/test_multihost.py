@@ -11,6 +11,19 @@ import socket
 import subprocess
 import sys
 
+import jax
+import pytest
+
+# Cross-process SPMD on the CPU backend postdates 0.4.x: there a jitted
+# computation over a multi-process mesh raises XlaRuntimeError
+# "Multiprocess computations aren't implemented on the CPU backend" in
+# every worker (the Gloo bootstrap itself succeeds — see
+# cluster.maybe_initialize_distributed).  Nothing to test until the
+# backend can run the program.
+pytestmark = pytest.mark.skipif(
+    jax.__version_info__ < (0, 5, 0),
+    reason="multiprocess SPMD unimplemented on this jax's CPU backend")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -182,7 +195,8 @@ def test_two_process_resident_eval_matches_host_eval(tmp_path):
 _NXM_TRAIN_SCRIPT = """
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {ndev})
+from distributedtensorflowexample_tpu.compat import set_num_cpu_devices
+set_num_cpu_devices({ndev})
 jax.config.update("jax_cpu_enable_async_dispatch", False)
 from distributedtensorflowexample_tpu.data import mnist
 mnist._SYNTH_SIZES = {{"train": 256, "test": 128}}
@@ -323,7 +337,8 @@ _NXM_EVAL_SCRIPT = """
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {ndev})
+from distributedtensorflowexample_tpu.compat import set_num_cpu_devices
+set_num_cpu_devices({ndev})
 jax.config.update("jax_cpu_enable_async_dispatch", False)
 from distributedtensorflowexample_tpu import cluster
 from distributedtensorflowexample_tpu.config import RunConfig

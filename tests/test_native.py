@@ -12,6 +12,11 @@ import pytest
 
 from distributedtensorflowexample_tpu import native
 from distributedtensorflowexample_tpu.data.cifar10 import _augment_numpy
+# The canonical f32 1/255 multiply (data/dequant.py): the native parser
+# and every numpy loader compute bytes -> floats this one way, so the
+# parity references here must too (a division rounds differently on
+# 126/256 byte values).
+from distributedtensorflowexample_tpu.data.dequant import U8_UNIT_SCALE
 
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native toolchain unavailable")
@@ -32,7 +37,7 @@ def _idx_label_bytes(n=50, seed=0):
 def test_idx_image_parse_matches_numpy():
     raw, pixels = _idx_image_bytes()
     got = native.parse_idx_images(raw)
-    want = pixels.reshape(50, 28, 28, 1).astype(np.float32) / 255.0
+    want = pixels.reshape(50, 28, 28, 1).astype(np.float32) * U8_UNIT_SCALE
     np.testing.assert_array_equal(got, want)
 
 
@@ -56,7 +61,7 @@ def test_cifar_parse_matches_numpy():
     recs[:, 0] = rng.randint(0, 10, size=n)
     got_imgs, got_lbls = native.parse_cifar(recs.tobytes())
     want = (recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
-            .astype(np.float32) / 255.0)
+            .astype(np.float32) * U8_UNIT_SCALE)
     np.testing.assert_array_equal(got_imgs, want)
     np.testing.assert_array_equal(got_lbls, recs[:, 0].astype(np.int32))
 
@@ -110,7 +115,7 @@ def test_mnist_loader_uses_native_and_matches(tmp_path):
         f.write(lbl_raw)
     x, y = load_mnist(str(tmp_path), "train")
     np.testing.assert_array_equal(
-        x, pixels.reshape(40, 28, 28, 1).astype(np.float32) / 255.0)
+        x, pixels.reshape(40, 28, 28, 1).astype(np.float32) * U8_UNIT_SCALE)
     np.testing.assert_array_equal(y, labels.astype(np.int32))
 
 
