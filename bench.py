@@ -434,19 +434,15 @@ def _roofline_probe(mesh, batch_per_chip: int, length: int = 256,
 
 def _cost_per_step(step, state, data, unroll: int) -> dict:
     """Per-step flops and bytes accessed from the compiled module's cost
-    analysis (best-effort: backends differ in which keys they report)."""
-    out = {}
-    try:
-        cost = step.lower(state, data).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        for key, name in (("flops", "flops"),
-                          ("bytes accessed", "bytes_accessed")):
-            if key in cost:
-                out[name] = float(cost[key]) / unroll
-    except Exception:
-        pass
-    return out
+    analysis (best-effort: backends differ in which keys they report).
+    Delegates to the ONE extraction implementation
+    (utils.profiling.cost_and_bytes_audit, audit half skipped) so bench
+    and profile records can never drift on the aggregate convention."""
+    from distributedtensorflowexample_tpu.utils.profiling import (
+        cost_and_bytes_audit)
+    cost, _ = cost_and_bytes_audit(step, (state, data), unroll=unroll,
+                                   audit=False)
+    return cost
 
 
 def _flops_per_step(step, state, data, unroll: int) -> float | None:

@@ -123,10 +123,19 @@ def main() -> None:
     HBM_BW = float(os.environ.get("TPU_HBM_BW", 819e9))   # v5e bytes/s
 
     def run_variant(tag, aug):
+        from distributedtensorflowexample_tpu.utils.profiling import (
+            cost_and_bytes_audit)
         step, ds, state, u = bench._make(
             "resnet20", "cifar10", args.batch_per_chip, args.unroll,
             mesh, augment=aug, lr=0.1)
-        cost = bench._cost_per_step(step, state, ds.peek(), u)
+        # One lower+compile serves both the aggregate cost keys AND the
+        # per-op bytes table (tools/bytes_audit.py's decomposition): the
+        # round-5 record carried only the aggregate, which over-counts
+        # the fused resident-split gather by the whole split array —
+        # effective bytes re-price it at rows-touched, and that is the
+        # honest denominator for the bandwidth roofline below.
+        cost, audit = cost_and_bytes_audit(step, (state, ds.peek()),
+                                           unroll=u, top_k=8)
         best, reps, state = bench._measure(step, ds, state, args.steps, u)
         rates[tag] = best
         flops, nbytes = cost.get("flops"), cost.get("bytes_accessed")
@@ -142,6 +151,16 @@ def main() -> None:
             detail["bw_roofline_steps_per_sec"] = round(HBM_BW / nbytes, 1)
             detail["mfu_ceiling_at_bw"] = round(
                 (HBM_BW / nbytes) * flops / bench.PEAK_FLOPS, 5)
+        if audit:
+            detail["bytes_audit"] = audit
+            nbytes_eff = audit.get("bytes_effective_per_step")
+            if flops and nbytes_eff:
+                detail["arith_intensity_effective"] = round(
+                    flops / nbytes_eff, 2)
+                detail["bw_roofline_effective_steps_per_sec"] = round(
+                    HBM_BW / nbytes_eff, 1)
+                detail["mfu_ceiling_at_bw_effective"] = round(
+                    (HBM_BW / nbytes_eff) * flops / bench.PEAK_FLOPS, 5)
         _emit(f"resnet20_profile_{tag}", best / n, detail)
         return step, ds, state, u
 

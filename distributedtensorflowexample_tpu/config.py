@@ -76,6 +76,19 @@ class RunConfig:
                                     # 0 = all
     dtype: str = "bfloat16"         # compute dtype on TPU (params stay f32)
 
+    # --- memory-traffic knobs (PR-2 bytes diet) ---
+    remat: str = "none"             # none | block — checkpoint each residual
+                                    # block: backward recomputes the block's
+                                    # forward instead of keeping activations
+                                    # resident (~1 extra forward of flops for
+                                    # an activation footprint of one block);
+                                    # resnet20 only, other models ignore it
+    shard_update: bool = False      # shard the f32 master-param update +
+                                    # optimizer state across the data mesh
+                                    # (arXiv:2004.13336): per-chip weight-
+                                    # update bytes drop ~1/D; params stay
+                                    # replicated for fwd/bwd (sync mode only)
+
     # --- hand-written TPU kernels (ops/pallas) ---
     pallas_ce: bool = False         # fused Pallas loss head in the train step
     fused_optimizer: bool = False   # fused Pallas momentum-SGD apply; measured
@@ -186,6 +199,15 @@ _FLAG_HELP = {
                              "gradients enter each update (rotating "
                              "subset); 0 = all",
     "dtype": "compute dtype (params stay float32)",
+    "remat": "none | block — rematerialize each residual block in the "
+             "backward pass (recompute instead of store; trades ~1 extra "
+             "forward of flops for an activation HBM footprint of one "
+             "block). Same math bitwise; resnet20 only",
+    "shard_update": "shard the optimizer state + weight-update compute "
+                    "across the data-parallel mesh (ZeRO-1 / "
+                    "arXiv:2004.13336): each chip updates 1/D of the "
+                    "params and the update is all-gathered; params stay "
+                    "replicated for compute. Sync mode only",
     "pallas_ce": "fused Pallas cross-entropy head",
     "fused_optimizer": "fused Pallas momentum-SGD (measured 2.3x slower "
                        "than XLA on v5e — kept as kernel reference; "

@@ -10,7 +10,8 @@ _REGISTRY = {
                                        dropout_rate=kw.get("dropout", 0.5),
                                        dtype=kw.get("dtype", jnp.bfloat16)),
     "resnet20": lambda **kw: ResNet20(num_classes=10,
-                                      dtype=kw.get("dtype", jnp.bfloat16)),
+                                      dtype=kw.get("dtype", jnp.bfloat16),
+                                      remat=kw.get("remat", "none")),
 }
 
 

@@ -44,14 +44,34 @@ class BasicBlock(nn.Module):
 
 
 class ResNetCIFAR(nn.Module):
-    """He-style CIFAR ResNet: depth = 6n+2 with n blocks per stage."""
+    """He-style CIFAR ResNet: depth = 6n+2 with n blocks per stage.
+
+    ``remat="block"`` checkpoints each residual block: the backward pass
+    recomputes the block's forward instead of keeping its activations
+    resident — activation HBM footprint drops from the whole 6n+2 stack
+    to one block's worth (plus the n+1 inter-block residuals), at the
+    price of roughly one extra forward pass of flops.  Same math, same
+    values (recomputation replays identical ops — parity is pinned
+    bitwise in tests/test_bytes.py); worth it when activations, not
+    weights, are what overflows HBM (deep stacks, large batch).
+    """
     blocks_per_stage: int = 3
     widths: tuple[int, ...] = (16, 32, 64)
     num_classes: int = 10
     dtype: jnp.dtype = jnp.bfloat16
+    remat: str = "none"           # none | block
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.remat not in ("none", "block"):
+            raise ValueError(f"unknown remat policy {self.remat!r} "
+                             "(one of none, block)")
+        block_cls = BasicBlock
+        if self.remat == "block":
+            # static_argnums counts __call__'s args with self at 0: the
+            # train flag (2) selects BN's running-average branch and must
+            # stay a python bool under the remat trace.
+            block_cls = nn.remat(BasicBlock, static_argnums=(2,))
         x = x.astype(self.dtype)
         x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype, name="conv_init")(x)
@@ -61,12 +81,18 @@ class ResNetCIFAR(nn.Module):
         for stage, width in enumerate(self.widths):
             for block in range(self.blocks_per_stage):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = BasicBlock(width, strides, self.dtype,
-                               name=f"stage{stage}_block{block}")(x, train=train)
+                x = block_cls(width, strides, self.dtype,
+                              name=f"stage{stage}_block{block}")(x, train)
+        # Pooling stays in f32 ONLY inside the reduction (jnp.mean's f32
+        # accumulator — fused into the reduce, verified by the PR-2 bytes
+        # audit); the first materialized f32 tensor is the [B, classes]
+        # logits below.
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         return x.astype(jnp.float32)
 
 
-def ResNet20(num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16) -> ResNetCIFAR:
-    return ResNetCIFAR(blocks_per_stage=3, num_classes=num_classes, dtype=dtype)
+def ResNet20(num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16,
+             remat: str = "none") -> ResNetCIFAR:
+    return ResNetCIFAR(blocks_per_stage=3, num_classes=num_classes,
+                       dtype=dtype, remat=remat)

@@ -4,6 +4,12 @@ The reference computed ``tf.nn.softmax_cross_entropy_with_logits`` + an
 accuracy eval op per script [RECONSTRUCTED]; here they are pure jnp
 functions.  The mean over the batch axis is the point where XLA inserts the
 cross-replica psum under data parallelism — no explicit collective code.
+
+Everything here runs in f32 on [B, C]-sized tensors by design: the models
+upcast logits at their boundary for loss stability, and the PR-2 bytes
+audit (BASELINE.md "bytes-attribution methodology") measured the whole
+loss path at ~10 KB/step on the flagship workload — downcasting it to
+bf16 would trade numerics for nothing.
 """
 
 from __future__ import annotations

@@ -212,6 +212,12 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         raise ValueError("--dequant_impl pallas fuses the on-device row "
                          "gather with the dequant; it requires the "
                          "replicated device-resident input path")
+    if cfg.shard_update and cfg.sync_mode == "async":
+        raise ValueError(
+            "--shard_update shards ONE replicated update across the mesh; "
+            "async mode's state is already worker-tiled (each device owns "
+            "its workers' whole update) — there is no cross-replica "
+            "redundancy to shard away")
 
     train_x, train_y = _load_dataset(cfg, dataset_name, "train")
     test_x, test_y = _load_dataset(cfg, dataset_name, "test")
@@ -235,10 +241,20 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         batches = DevicePrefetcher(batcher, sharding=data_shard)
 
     model = build_model(model_name, dropout=cfg.dropout,
-                        dtype=jnp.dtype(cfg.dtype))
+                        dtype=jnp.dtype(cfg.dtype), remat=cfg.remat)
     tx = build_optimizer(cfg, mesh=mesh)
     sample_shape = (global_batch,) + _SAMPLE_SHAPES[dataset_name]
     state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
+    if cfg.shard_update:
+        # create_sharded lays the WHOLE state out replicated; re-lay the
+        # optimizer state into its 1/D-per-device sharding now so the
+        # step's first call already matches the in-step constraints
+        # (donation aliases from call one, no replicated->sharded
+        # recompile on call two).
+        from distributedtensorflowexample_tpu.training.optimizers import (
+            update_shardings)
+        state = state.replace(opt_state=jax.device_put(
+            state.opt_state, update_shardings(state.opt_state, mesh)))
 
     is_async = cfg.sync_mode == "async"
     if is_async and cfg.replicas_to_aggregate:

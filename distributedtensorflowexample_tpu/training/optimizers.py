@@ -4,12 +4,17 @@ SyncReplicasOptimizer wrapping — SURVEY.md §3b/§3c).
 Sync gradient aggregation needs no optimizer wrapper here: by the time
 updates are applied the gradients are already the global-batch mean (XLA
 psum inside the jitted step), which is exactly what SyncReplicasOptimizer's
-PS-side accumulator barrier produced.  So this module only builds the base
-transformation + LR schedule.
+PS-side accumulator barrier produced.  So this module builds the base
+transformation + LR schedule, plus one execution-strategy wrapper:
+:func:`cross_replica_update_sharding` (the ``--shard_update`` flag) shards
+the weight update itself across the data mesh per Xu et al.,
+arXiv:2004.13336 — the step definition is unchanged, only WHERE each
+parameter's update runs moves (TF-Replicator's separation, 1902.00465).
 """
 
 from __future__ import annotations
 
+import jax
 import optax
 
 from distributedtensorflowexample_tpu.config import RunConfig
@@ -38,6 +43,84 @@ def build_schedule(cfg: RunConfig) -> optax.Schedule:
     return sched
 
 
+def _update_shard_spec(shape, axis_name: str, num_shards: int):
+    """PartitionSpec sharding the LARGEST axis divisible by *num_shards*
+    (replicated when none is).  Per-leaf by shape only, so the optimizer
+    state (params-shaped moments) and the gradients resolve identically
+    without any tree-structure coupling."""
+    from jax.sharding import PartitionSpec as P
+    best = None
+    for i, d in enumerate(shape):
+        if d % num_shards == 0 and d >= num_shards:
+            if best is None or d > shape[best]:
+                best = i
+    if best is None:
+        return P()
+    parts = [None] * len(shape)
+    parts[best] = axis_name
+    return P(*parts)
+
+
+def update_shardings(tree, mesh):
+    """Per-leaf NamedShardings for a params-like pytree under the
+    cross-replica update sharding (scalars and indivisible leaves
+    replicated).  Used to lay out the INITIAL optimizer state so the
+    step's first call already sees the sharded layout (donation aliases
+    from call one; no replicated->sharded recompile)."""
+    from jax.sharding import NamedSharding
+    from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+    D = mesh.shape[DATA_AXIS]
+    return jax.tree.map(
+        lambda x: NamedSharding(
+            mesh, _update_shard_spec(getattr(x, "shape", ()), DATA_AXIS, D)),
+        tree)
+
+
+def cross_replica_update_sharding(tx: optax.GradientTransformation,
+                                  mesh) -> optax.GradientTransformation:
+    """Shard the weight update + optimizer state across the data mesh
+    (``--shard_update``; Xu et al., arXiv:2004.13336 / ZeRO-1).
+
+    Inside the jitted step, sharding constraints pin the gradients
+    entering ``tx.update``, the optimizer state, and the produced updates
+    to a 1/D shard per device (largest divisible axis).  The SPMD
+    partitioner then materializes exactly the paper's schedule: the
+    gradient all-reduce decomposes into reduce-scatter + (sharded update
+    math) + all-gather of the updates — per-chip weight-update HBM
+    traffic and optimizer-state residency drop ~1/D, while params stay
+    replicated so forward/backward are untouched.  The transformation's
+    MATH is unchanged (constraints only place data; the update is
+    elementwise per parameter); only the gradient summation order may
+    legitimately change (reduce-scatter vs all-reduce), which is why the
+    parity test asserts allclose, not bitwise.
+
+    No-op on a 1-extent data axis."""
+    from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+    if mesh.shape[DATA_AXIS] <= 1:
+        return tx
+
+    def constrain(tree):
+        # ONE leaf->sharding rule: the same update_shardings that lays
+        # out the initial optimizer state, so the in-step constraints can
+        # never drift from the call-one layout (scalars replicate — a
+        # replicated constraint is a no-op, no special-casing needed).
+        return jax.tree.map(jax.lax.with_sharding_constraint,
+                            tree, update_shardings(tree, mesh))
+
+    def init(params):
+        return constrain(tx.init(params))
+
+    def update(updates, state, params=None):
+        new_updates, new_state = tx.update(
+            constrain(updates), state,
+            constrain(params) if params is not None else None)
+        # The sharded updates feed optax.apply_updates against replicated
+        # params — GSPMD inserts the closing all-gather there.
+        return constrain(new_updates), constrain(new_state)
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(cfg: RunConfig,
                     mesh=None) -> optax.GradientTransformation:
     sched = build_schedule(cfg)
@@ -47,6 +130,11 @@ def build_optimizer(cfg: RunConfig,
                 "--fused_optimizer implements momentum SGD only; it needs "
                 f"momentum > 0 (got {cfg.momentum}) and weight_decay == 0 "
                 f"(got {cfg.weight_decay})")
+        if cfg.shard_update:
+            raise ValueError(
+                "--shard_update shards the update with XLA sharding "
+                "constraints; the Pallas fused apply is a custom call XLA "
+                "cannot re-partition — use one or the other")
         # Hand-written Pallas apply (ops/pallas/sgd.py); optax-compatible.
         from distributedtensorflowexample_tpu.ops.pallas import (
             fused_momentum_sgd)
@@ -57,4 +145,8 @@ def build_optimizer(cfg: RunConfig,
         tx = optax.sgd(sched)
     if cfg.weight_decay > 0.0:
         tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    if cfg.shard_update:
+        if mesh is None:
+            raise ValueError("--shard_update requires a device mesh")
+        tx = cross_replica_update_sharding(tx, mesh)
     return tx

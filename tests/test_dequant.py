@@ -469,3 +469,46 @@ def test_train_loop_calls_prefetch_hook():
                      Batches(), num_steps=3)
     loop.run(State())
     assert len(calls) == 3
+
+
+# ---- 5. bench-config attestation (ROADMAP host-fed dequant check) -------
+
+def test_bench_async_and_host_fed_configs_attest_affine_under_auto(
+        tmp_path, small_synthetic):
+    """Under --dequant auto NO bench path may silently regress to a LUT
+    form (the round-5 tax).  The async bench config's detail.dequant line
+    is ds.dequant_impl of the dataset bench._make builds — assert it
+    resolves affine end-to-end through the real bench factory; the
+    host-fed path resolves through dequant_host_batch's rule — assert the
+    same AND that the jitted host-fed step contains no 256-gather."""
+    import bench
+    from distributedtensorflowexample_tpu.data.pipeline import Batcher
+
+    # Async config (config 2), built exactly as bench.main does (sync=
+    # False), on a 1-device mesh; data_dir points at an empty tmp dir so
+    # the loader takes the deterministic synthetic fallback.
+    mesh = make_mesh(1)
+    with mesh:
+        _, ds, _, _ = bench._make("mnist_cnn", "mnist", 32, 1, mesh,
+                                  sync=False, data_dir=str(tmp_path),
+                                  dequant_impl="auto")
+    assert ds.dequant_impl == "affine", (
+        f"async bench config resolved {ds.dequant_impl!r} under auto — "
+        "detail.dequant would attest a LUT-family regression")
+
+    # Host-fed: the Batcher quantizes the split and carries the spec; the
+    # in-step dequant resolves through the SAME rule (dequant_host_batch).
+    x, y = _data(64)
+    batcher = Batcher(np.asarray(x), np.asarray(y), 32, quantize="auto")
+    assert batcher.dequant is not None
+    assert resolve_dequant_impl(batcher.dequant, "auto", "auto") == "affine"
+    step = make_train_step(dequant=batcher.dequant)       # auto default
+    state = TrainState.create(build_model("softmax"), optax.sgd(0.1),
+                              np.zeros((32, 28, 28, 1), np.float32))
+    batch = next(iter(batcher))
+    assert batch["image"].dtype == np.uint8               # quantized feed
+    jaxpr = jax.make_jaxpr(lambda s, b: step(s, b))(
+        state, {"image": jnp.asarray(batch["image"]),
+                "label": jnp.asarray(batch["label"])})
+    assert _gather_eqns(jaxpr) == [], (
+        "host-fed auto path traces a 256-entry table gather")

@@ -24,6 +24,7 @@ cd "$(dirname "$0")/.." || exit 1
 OUT=${OUT:-BENCH_auto_r05.json}
 OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r05.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_auto_r05.json}
+BYTES_OUT=${BYTES_OUT:-BYTES_AUDIT_r05.json}
 TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r05.tgz}
 CLI_OUT=${CLI_OUT:-CLI_r05.log}
 TRACE_DIR=${TRACE_DIR:-/tmp/resnet_trace}
@@ -53,11 +54,33 @@ keep() { # $1=tmp $2=final
   if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
 }
 
+# Phase 2b body, callable from two places: the normal phase-2b slot AND
+# every wedge bail.  The CPU audit is tunnel-free, so a wedged chip must
+# never cost us the one artifact that doesn't need the chip — but it
+# must not run BEFORE the on-chip phases either (it burns real window
+# wall time on this shared host).  Guarded by an in-process flag: at
+# most once per capture RUN (a $BYTES_OUT left by a PREVIOUS window
+# must not suppress this window's fresh table — the phase-4
+# fresh_measured stale-file lesson).
+BYTES_AUDIT_RAN=0
+run_bytes_audit() {
+  [ "$BYTES_AUDIT_RAN" = 1 ] && return 0
+  BYTES_AUDIT_RAN=1
+  python tools/bytes_audit.py --backend cpu --workload resnet20 \
+    ${BYTES_ARGS:---batch_per_chip 256 --unroll 1} \
+    --json "$BYTES_OUT.tmp" >> "$LOG" 2>&1
+  echo "bytes audit (cpu) rc=$?" >> "$LOG"
+  # keep() checks -s on the JSON; the tool writes it only on success.
+  keep "$BYTES_OUT.tmp" "$BYTES_OUT"
+}
+
 # $1=rc $2=msg — a watchdog exit (rc=3) means the backend is provably
-# wedged; stop burning the window on the remaining phases.
+# wedged; stop burning the window on the remaining ON-CHIP phases (the
+# CPU-only audit still lands first — it cannot wedge on the tunnel).
 bail_if_wedged() {
   [ "$1" -eq 3 ] || return 0
   echo "$2" >> "$LOG"
+  run_bytes_audit
   date -u >> "$LOG"
   exit 3
 }
@@ -89,6 +112,17 @@ if [ "$rc2" -eq 0 ] && [ -d "$TRACE_DIR" ]; then
     echo "trace too big to commit (${sz}MB), left in $TRACE_DIR" >> "$LOG"
   fi
 fi
+# --- phase 2b: per-op bytes attribution (CPU backend, tunnel-free) --------
+# The on-chip per-op table rides inside $PROFILE_OUT (bench_profile emits
+# detail.bytes_audit per variant); this archives the CPU-methodology
+# table alongside it for the A/B BASELINE.md documents.  Runs on the CPU
+# backend IN-PROCESS (--backend cpu: sitecustomize overrides the
+# JAX_PLATFORMS env var, so the pin must happen inside the tool); a
+# wedge bail in ANY phase also runs it on the way out (see
+# run_bytes_audit), so a dead chip cannot block it — re-driven
+# end-to-end against the down backend, PR 2: phases 1-3 sentinel, the
+# audit JSON still lands.
+run_bytes_audit
 bail_if_wedged "$rc2" "full bench skipped: profile watchdog fired (backend wedged)"
 
 # --- phase 3: full bench --------------------------------------------------
