@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -129,12 +130,19 @@ _PROBE_CODE = (
 _PROBE_PROC: subprocess.Popen | None = None
 
 
-def _probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> tuple[bool, str]:
+def _probe_backend(timeout_s: float | None = None) -> tuple[bool, str]:
     """Touch the backend (import + tiny matmul) in a subprocess so a hung
     init costs ``timeout_s``, not 25-45 min of the driver's run.  SIGTERM
     with a grace period before SIGKILL: hard-killing a process mid-init
-    has wedged the shared tunnel before (see docs/DESIGN.md)."""
+    has wedged the shared tunnel before (see docs/DESIGN.md).
+
+    ``timeout_s=None`` reads PROBE_TIMEOUT_S at CALL time (not def time)
+    so the --probe_timeout_s CLI knob and monkeypatched tests govern
+    probes issued after startup — the watch log showed every probe of a
+    215-probe outage burning exactly the def-time 300 s."""
     global _PROBE_PROC
+    if timeout_s is None:
+        timeout_s = PROBE_TIMEOUT_S
     proc = subprocess.Popen(
         [sys.executable, "-c", _PROBE_CODE],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
@@ -198,9 +206,15 @@ def _wait_for_backend(into: list | None = None) -> tuple[bool, list]:
               flush=True)
         if ok:
             return True, attempts
-        if time.time() + RETRY_INTERVAL_S + PROBE_TIMEOUT_S > deadline:
+        # Jittered backoff (resilience round): every supervisor/watcher
+        # retrying a shared tunnel on the same fixed 240-s grid probes in
+        # synchronized bursts — the uniform +/-25% spread decorrelates
+        # them, and the deadline check uses the ACTUAL sleep so the
+        # budget math stays exact.
+        sleep_s = RETRY_INTERVAL_S * (0.75 + 0.5 * random.random())
+        if time.time() + sleep_s + PROBE_TIMEOUT_S > deadline:
             return False, attempts
-        time.sleep(RETRY_INTERVAL_S)
+        time.sleep(sleep_s)
 
 
 def _arm_watchdog(budget_s: float, fire, _exit=os._exit) -> threading.Event:
@@ -1024,5 +1038,19 @@ if __name__ == "__main__":
         help="in-step dequant impl for resident splits; auto resolves the "
              "fast path per split AND A/Bs the alternatives at the winning "
              "unroll, recording the selection in the headline detail")
-    DEQUANT = _ap.parse_args().dequant
+    _ap.add_argument(
+        "--probe_timeout_s", type=float, default=PROBE_TIMEOUT_S,
+        help="per-probe backend timeout (env BENCH_PROBE_TIMEOUT_S; the "
+             "round-5 watch log burned exactly 300 s per probe for 215 "
+             "probes — shorter probes + the jittered retry backoff sample "
+             "an outage's edges faster)")
+    _ap.add_argument(
+        "--retry_interval_s", type=float, default=RETRY_INTERVAL_S,
+        help="mean pause between failed probes (env BENCH_RETRY_INTERVAL_S"
+             "; actual sleeps are jittered +/-25%% to decorrelate "
+             "fleet-wide retry bursts against the shared tunnel)")
+    _args = _ap.parse_args()
+    DEQUANT = _args.dequant
+    PROBE_TIMEOUT_S = _args.probe_timeout_s
+    RETRY_INTERVAL_S = _args.retry_interval_s
     main()

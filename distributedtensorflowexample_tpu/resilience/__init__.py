@@ -1,0 +1,27 @@
+"""resilience/ — fault injection + crash-consistent snapshots + supervised
+recovery (the subsystem the rounds-3-5 outage said this repo needed).
+
+Three cooperating pieces, each usable alone:
+
+- :mod:`.faults` — deterministic, seed-addressable fault plans injected at
+  train-loop boundaries and into the batch stream (preemption, wedged
+  dispatch, NaN loss, corrupted uint8 batch, torn checkpoint write).
+- :mod:`.snapshot` — atomic write-tmp/fsync/rename snapshots with a
+  manifest (step, optimizer state, RNG key, dataset cursor, crc32), so a
+  resume is bitwise-identical to an uninterrupted run and a torn write is
+  detected and discarded instead of restored.
+- :mod:`.supervisor` — runs any entrypoint under a heartbeat watchdog
+  with exponential backoff + jitter, bounded retries, and a journaled
+  priority task queue that survives the supervisor's own death.
+
+Everything here runs on CPU — the outage this subsystem exists for can
+never block its own tests.
+"""
+
+from distributedtensorflowexample_tpu.resilience.faults import (  # noqa: F401
+    FAULT_KINDS, FaultInjectionHook, FaultPlan, FaultSpec, FaultyBatches,
+    MetricsTapeHook, NaNGuardHook)
+from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401
+    SnapshotHook, SnapshotStore)
+from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: F401
+    RetryPolicy, SupervisedResult, Supervisor, Task, TaskQueue)

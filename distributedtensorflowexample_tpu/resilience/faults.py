@@ -1,0 +1,271 @@
+"""Deterministic, seed-addressable fault injection for the train loop.
+
+Every fault the rounds-3-5 outage (OUTAGE_r05.md) and the round-2/3
+postmortems actually produced, reproducible on CPU at will:
+
+==================  =====================================================
+kind                models
+==================  =====================================================
+``preemption``      the platform's SIGTERM before slice reclaim — raised
+                    at an exact step boundary via the process's real
+                    signal path, so the loop's cooperative-stop +
+                    save-on-exit machinery is what gets exercised
+``wedge``           a dispatch that blocks without raising (the
+                    ``bench._probe_backend`` 300-s hang / round-3
+                    mid-run backend loss) — a boundary sleep that
+                    starves the supervisor's heartbeat
+``nan_loss``        numeric blowup: the covered FLOAT batch is poisoned
+                    so the loss goes non-finite (NaNGuardHook fails fast
+                    before the poisoned state can be snapshotted);
+                    refused loudly on uint8 batches — no NaN byte exists
+                    (use ``corrupt_batch`` there)
+``corrupt_batch``   a corrupted uint8 batch off the wire: deterministic
+                    garbage bytes for uint8 images, non-finite-driving
+                    magnitudes for float images
+``torn_snapshot``   a checkpoint write torn mid-file — applied to the
+                    newest snapshot AFTER the final save (see
+                    tools/faultline.py), so recovery must fall back to
+                    the previous manifest-valid snapshot
+==================  =====================================================
+
+A plan is addressed by ``(text, num_steps, seed)``: unpinned fault steps
+are drawn from ``random.Random`` seeded with those, so the same CLI line
+reproduces the same scenario anywhere (tools/faultline.py), and a
+different seed explores a different schedule with no code change.
+
+Loop-level faults ride the Hook surface (training/hooks.py); batch-level
+faults wrap the batch iterator (FaultyBatches mirrors TrainLoop's
+``steps_per_call`` arithmetic so a fault step inside a fused window
+poisons exactly the window that covers it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import signal
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributedtensorflowexample_tpu.training.hooks import Hook, _EveryN
+
+FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
+               "torn_snapshot")
+_BATCH_KINDS = ("nan_loss", "corrupt_batch")
+_POST_EXIT_KINDS = ("torn_snapshot",)
+
+# Named plans: the scenario library tools/faultline.py exposes.  A None
+# step is drawn deterministically from the plan seed (one shared anchor
+# per plan, so e.g. torn_snapshot+preemption land at the SAME step — the
+# "final write torn" shape).
+NAMED_PLANS = {
+    "none": [],
+    "preempt": [("preemption", None, 0.0)],
+    "wedge": [("wedge", None, 2.0)],
+    "nan_loss": [("nan_loss", None, 0.0)],
+    "corrupt_batch": [("corrupt_batch", None, 0.0)],
+    "torn_snapshot": [("torn_snapshot", None, 0.0),
+                      ("preemption", None, 0.0)],
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    step: int           # global step the fault fires at (boundary/window)
+    arg: float = 0.0    # kind-specific (wedge: seconds to block)
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {FAULT_KINDS})")
+        if self.step < 1:
+            raise ValueError(f"fault step {self.step} must be >= 1")
+
+
+class FaultPlan:
+    """An ordered set of FaultSpecs plus the seed that addressed them."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0,
+                 name: str = ""):
+        self.specs = sorted(specs, key=lambda s: (s.step, s.kind))
+        self.seed = seed
+        self.name = name
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    @property
+    def batch_specs(self) -> list[FaultSpec]:
+        return [s for s in self.specs if s.kind in _BATCH_KINDS]
+
+    @property
+    def loop_specs(self) -> list[FaultSpec]:
+        return [s for s in self.specs
+                if s.kind not in _BATCH_KINDS + _POST_EXIT_KINDS]
+
+    @property
+    def post_exit_specs(self) -> list[FaultSpec]:
+        return [s for s in self.specs if s.kind in _POST_EXIT_KINDS]
+
+    @classmethod
+    def parse(cls, text: str, num_steps: int, seed: int = 0) -> "FaultPlan":
+        """Build a plan from CLI text: comma-separated tokens, each a
+        named plan from NAMED_PLANS or ``kind[@step][:arg]`` (e.g.
+        ``preemption@3`` or ``wedge:5.0``).  Unpinned steps share one
+        anchor drawn deterministically from ``(text, num_steps, seed)``
+        in ``[1, num_steps-1]`` — mid-run, never the final step, so
+        there is always work left for the recovery to prove itself on."""
+        rng = random.Random(f"{text}|{num_steps}|{seed}")
+        anchor = rng.randrange(1, max(2, num_steps))
+        specs: list[FaultSpec] = []
+        for token in filter(None, (t.strip() for t in text.split(","))):
+            if token in NAMED_PLANS:
+                for kind, step, arg in NAMED_PLANS[token]:
+                    specs.append(FaultSpec(kind, anchor if step is None
+                                           else step, arg))
+                continue
+            body, _, argtxt = token.partition(":")
+            kind, _, steptxt = body.partition("@")
+            specs.append(FaultSpec(
+                kind, int(steptxt) if steptxt else anchor,
+                float(argtxt) if argtxt else
+                (2.0 if kind == "wedge" else 0.0)))
+        return cls(specs, seed=seed, name=text)
+
+
+class FaultInjectionHook(Hook):
+    """Fires loop-level faults at their exact step boundaries.
+
+    Boundary placement is load-bearing: the train step DONATES its input
+    state, so faults must land where the loop's own interruption
+    machinery lands (see TrainLoop.should_stop) — after a completed
+    step, never inside the dispatched call.  A resumed loop whose
+    ``start_step`` already passed a fault marks it fired (the run
+    already lived through it)."""
+
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+        self._fired: set[int] = set()
+
+    def begin(self, loop) -> None:
+        for i, s in enumerate(self._plan.loop_specs):
+            if s.step <= loop.start_step:
+                self._fired.add(i)
+
+    def after_step(self, step, state, metrics) -> bool:
+        for i, s in enumerate(self._plan.loop_specs):
+            if i in self._fired or step < s.step:
+                continue
+            self._fired.add(i)
+            if s.kind == "wedge":
+                # Blocks without raising — exactly what a dead tunnel
+                # does to a jit call.  The heartbeat goes stale; only an
+                # external watchdog (resilience.supervisor) can act.
+                time.sleep(s.arg)
+            elif s.kind == "preemption":
+                # Through the real signal path, not a direct flag poke:
+                # the handler installation, the cooperative poll, and
+                # the save-on-exit are all under test.
+                signal.raise_signal(signal.SIGTERM)
+        return False
+
+
+class FaultyBatches:
+    """Batch-iterator wrapper that corrupts the batch whose step window
+    covers a batch-fault step.  Tracks the loop's position with the same
+    ``start_step``/``steps_per_next`` arithmetic as DeviceDataset, so it
+    composes with fused multi-step calls."""
+
+    def __init__(self, batches, plan: FaultPlan, start_step: int = 0,
+                 steps_per_next: int = 1):
+        self._it = iter(batches)
+        self._plan = plan
+        self._step = int(start_step)
+        self._spn = max(1, steps_per_next)
+        self._rng = np.random.default_rng(plan.seed)
+        self._fired = {i for i, s in enumerate(plan.batch_specs)
+                       if s.step <= start_step}
+        # TrainLoop reads .prefetch at construction; forward the wrapped
+        # iterator's (None when absent keeps the loop's skip behavior).
+        self.prefetch = getattr(batches, "prefetch", None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        lo, hi = self._step + 1, self._step + self._spn
+        self._step = hi
+        for i, s in enumerate(self._plan.batch_specs):
+            if i in self._fired or not (lo <= s.step <= hi):
+                continue
+            self._fired.add(i)
+            batch = self._corrupt(batch, s.kind)
+        return batch
+
+    def _corrupt(self, batch, kind: str):
+        img = np.asarray(batch["image"])
+        if kind == "nan_loss":
+            # The kind check comes FIRST: a nan_loss that silently
+            # degraded to legal random bytes on a uint8 pipeline would
+            # make the NaN-guard drill pass vacuously — the guard never
+            # fires, yet the scenario reports success.
+            if img.dtype == np.uint8:
+                raise ValueError(
+                    "nan_loss cannot be represented in a uint8 batch "
+                    "(no NaN byte exists); use corrupt_batch for uint8 "
+                    "pipelines or inject on the float (host-fed) path")
+            bad = np.full(img.shape, np.nan, img.dtype)
+        elif img.dtype == np.uint8:
+            # A corrupted uint8 batch off the wire: every value is still
+            # a legal byte, so only training dynamics (or a checksum
+            # upstream) can notice — deterministic from the plan seed.
+            bad = self._rng.integers(0, 256, img.shape, dtype=np.uint8)
+        else:
+            # Finite but loss-exploding magnitudes: overflow to inf/nan
+            # inside the forward pass, not in the input itself.
+            bad = (self._rng.standard_normal(img.shape) * 1e38).astype(
+                img.dtype)
+        return {**batch, "image": jnp.asarray(bad)}
+
+
+class NaNGuardHook(Hook):
+    """Fail fast on a non-finite loss.
+
+    Raises at the call boundary (safe: donation completed) so the
+    process dies BEFORE the poisoned state reaches a snapshot — the
+    exception propagates past the end hooks, the last save on disk is
+    the last healthy step, and a supervisor restart resumes from there
+    instead of training forward on garbage."""
+
+    def __init__(self, every: int = 1):
+        self._due = _EveryN(max(1, every))
+
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._due._every, int(loop.start_step))
+
+    def after_step(self, step, state, metrics) -> bool:
+        if self._due(step):
+            loss = float(np.asarray(metrics["loss"]))
+            if not np.isfinite(loss):
+                raise FloatingPointError(
+                    f"non-finite loss {loss} at step {step} — refusing to "
+                    f"snapshot a poisoned state; restart resumes from the "
+                    f"last healthy snapshot")
+        return False
+
+
+class MetricsTapeHook(Hook):
+    """Record the (step, loss) trajectory — the metric half of the
+    bitwise resume-parity contract (a resumed run must reproduce not
+    just the final params but every logged value along the way)."""
+
+    def __init__(self):
+        self.tape: list[tuple[int, float]] = []
+
+    def after_step(self, step, state, metrics) -> bool:
+        self.tape.append((step, float(np.asarray(metrics["loss"]))))
+        return False

@@ -9,6 +9,7 @@ final eval.  Each entrypoint script just supplies flag defaults.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -397,16 +398,10 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # default) from the last completed step.  Raising from the handler
     # instead is unsafe: the step donates its input state, and an
     # exception landing mid-call leaves deleted buffers (see TrainLoop).
-    import signal
+    from distributedtensorflowexample_tpu.utils.signals import sigterm_flag
 
-    from distributedtensorflowexample_tpu.utils.signals import (
-        installed_signal_handler)
-
-    sigterm_seen = []
     stop_agreed = []
-
-    def _on_term(signum, frame):
-        sigterm_seen.append(True)
+    preempted = None    # bound by the sigterm_flag context below
 
     if jax.process_count() > 1:
         # Multi-host: the stop decision must be UNANIMOUS at the SAME
@@ -427,7 +422,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
         def _consensus():
             agreed = bool(multihost_utils.process_allgather(
-                np.int32(bool(sigterm_seen))).max())
+                np.int32(bool(preempted))).max())
             if agreed:
                 stop_agreed.append(True)
             return agreed
@@ -440,13 +435,22 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             return _consensus()
     else:
         def _consensus():
-            if sigterm_seen:
+            if preempted:
                 stop_agreed.append(True)
-            return bool(sigterm_seen)
+            return bool(preempted)
 
         _should_stop = _consensus
 
-    with installed_signal_handler(signal.SIGTERM, _on_term):
+    # Supervised runs (tools/supervise.py) export SUPERVISE_HEARTBEAT;
+    # the boundary touches are what let the watchdog distinguish a wedged
+    # dispatch from a long quiet stretch of healthy fused steps.
+    hb_path = os.environ.get("SUPERVISE_HEARTBEAT", "")
+    if hb_path:
+        from distributedtensorflowexample_tpu.training.hooks import (
+            HeartbeatHook)
+        hooks.append(HeartbeatHook(hb_path, every=_CONSENSUS_POLL_STEPS))
+
+    with sigterm_flag() as preempted:
         with mesh:
             loop = TrainLoop(train_step, batches, cfg.train_steps, hooks,
                              logger, steps_per_call=steps_per_call,

@@ -19,11 +19,18 @@ import orbax.checkpoint as ocp
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
-def _saveable(state: TrainState) -> dict[str, Any]:
+def saveable_state_dict(state: TrainState) -> dict[str, Any]:
+    """The serializable subset of a TrainState — THE one definition of
+    what a checkpoint contains, shared with the crash-consistent
+    snapshot format (resilience/snapshot.py) so the two restore paths
+    can never drift on which fields make a run resumable."""
     # tx/apply_fn are static code, not state — exclude from serialization.
     return {"step": state.step, "params": state.params,
             "opt_state": state.opt_state, "batch_stats": state.batch_stats,
             "rng": state.rng}
+
+
+_saveable = saveable_state_dict
 
 
 class CheckpointManager:

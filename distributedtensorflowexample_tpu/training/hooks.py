@@ -8,6 +8,7 @@ the loop stays a plain Python for-loop around one jitted call.
 
 from __future__ import annotations
 
+import os
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
@@ -64,6 +65,39 @@ class CheckpointHook(Hook):
     def end(self, state) -> None:
         self._manager.save(int(state.step), state, force=True)
         self._manager.wait()
+
+
+class HeartbeatHook(Hook):
+    """Touch ``path`` at call boundaries so an external watchdog
+    (resilience.supervisor) can tell a slow-but-alive run from a wedged
+    dispatch: a jit call blocked on a dead backend never returns to the
+    boundary, so the touches stop — the liveness signal a wall timeout
+    alone can't give.  Installed automatically by run_training and
+    tools/faultline.py when the supervisor exports SUPERVISE_HEARTBEAT."""
+
+    def __init__(self, path: str, every: int = 1):
+        self._path = path
+        self._due = _EveryN(max(1, every))
+
+    def _touch(self) -> None:
+        try:
+            with open(self._path, "a"):
+                pass
+            os.utime(self._path)
+        except OSError:
+            pass    # a full disk must not kill the run the beat protects
+
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._due._every, int(loop.start_step))
+        self._touch()
+
+    def after_step(self, step, state, metrics) -> bool:
+        if self._due(step):
+            self._touch()
+        return False
+
+    def end(self, state) -> None:
+        self._touch()
 
 
 class EvalHook(Hook):

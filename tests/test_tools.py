@@ -37,7 +37,7 @@ def _write_shim(bindir, name, body):
 
 def _run_watcher(tmp_path, *, bench_age_s=None, cap_age_s=None,
                  probe="fail_once", stale_s=None, done_when, timeout_s=60,
-                 settle_s=0.0):
+                 settle_s=0.0, extra_env=None):
     """Start the real tools/tpu_watch.sh under shims and stop it once
     ``done_when(log_text)`` is true (or on timeout).
 
@@ -83,9 +83,14 @@ def _run_watcher(tmp_path, *, bench_age_s=None, cap_age_s=None,
                WATCH_LOG=str(watch_log),
                RECOVERED_MARKER=str(marker),
                CAPTURE_PIDFILE=str(pidfile),
-               PROBE_INTERVAL_S="1")
+               PROBE_INTERVAL_S="1",
+               # Shrink the stale-kill TERM->KILL grace (default 35 s —
+               # sized to outlast the supervisor's child escalation)
+               # so kill-path tests finish inside their polling windows.
+               CAPTURE_KILL_GRACE_S="2")
     if stale_s is not None:
         env["STALE_S"] = str(stale_s)
+    env.update(extra_env or {})
     proc = subprocess.Popen(["bash", os.path.join(REPO, "tools",
                                                   "tpu_watch.sh")],
                             env=env, cwd=REPO,
@@ -121,8 +126,22 @@ def test_recovery_edge_kills_stale_bench_and_launches_once(tmp_path):
     assert marker.exists()
     lines = launches.read_text().strip().splitlines()
     assert len(lines) == 1, lines
-    assert "bench_capture.sh" in lines[0]
+    # Default launcher is the SUPERVISED capture (journaled resume);
+    # CAPTURE_LAUNCHER=bash selects the legacy inline phases.
+    assert "supervise.py --capture" in lines[0]
     assert log.count("launching auto-capture") == 1
+
+
+def test_recovery_edge_bash_fallback_launcher(tmp_path):
+    """CAPTURE_LAUNCHER=bash keeps the battle-tested inline
+    bench_capture.sh path behind the flag."""
+    log, launches, _, _ = _run_watcher(
+        tmp_path, bench_age_s=1000, probe="fail_twice",
+        done_when=lambda log: "launching auto-capture" in log,
+        settle_s=3.0, extra_env={"CAPTURE_LAUNCHER": "bash"})
+    assert "launching auto-capture (bash fallback)" in log
+    lines = launches.read_text().strip().splitlines()
+    assert len(lines) == 1 and "bench_capture.sh" in lines[0]
 
 
 def test_single_flap_edge_never_kills(tmp_path):
@@ -138,9 +157,14 @@ def test_single_flap_edge_never_kills(tmp_path):
 
 
 def test_young_bench_is_left_alone(tmp_path):
+    # PROBE_TIMEOUT_S as a FLOAT: valid for the python probe consumer,
+    # and the watcher's derived outer timeout must truncate it rather
+    # than fatally erroring in bash arithmetic (which would turn every
+    # probe into a permanent FAIL).
     log, launches, _, _ = _run_watcher(
         tmp_path, bench_age_s=60,     # re-acquired the backend itself
-        done_when=lambda log: "young bench" in log)
+        done_when=lambda log: "young bench" in log,
+        extra_env={"PROBE_TIMEOUT_S": "2.5"})
     assert "young bench already capturing; not launching" in log
     assert "killing stale bench" not in log
     assert not launches.exists()
@@ -168,7 +192,7 @@ def test_stale_capture_group_killed_and_fresh_launch(tmp_path):
         settle_s=3.0)
     assert f"killing stale capture group {FAKE_CAP_PID}" in log
     assert log.count("launching auto-capture") == 1
-    assert launches.read_text().count("bench_capture.sh") == 1
+    assert launches.read_text().count("supervise.py --capture") == 1
     assert not pidfile.exists()       # stale pidfile cleaned by watcher
 
 

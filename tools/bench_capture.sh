@@ -19,8 +19,25 @@
 # Detached on purpose: a tool-timeout SIGKILL on a chip-holding process
 # wedges the shared tunnel (verify skill), so captures must never run
 # under a harness timeout.
+#
+# KEEP IN SYNC with tools/supervise.py _capture_tasks (the supervised
+# default path): phase set, artifact filenames, env knobs, gates.  Any
+# phase change must land in BOTH until this bash path is retired.
 
 cd "$(dirname "$0")/.." || exit 1
+
+# CAPTURE_SUPERVISED=1 delegates the whole sequence to the journaled
+# supervisor (tools/supervise.py --capture): same phases, same env knobs,
+# same pidfile — plus resume-across-windows and wedge-aware skipping.
+# tools/tpu_watch.sh launches supervise.py directly on a recovery edge
+# (CAPTURE_LAUNCHER=supervised, its default); this guard gives hand
+# launches of THIS script the same path, with the inline bash phases
+# below kept as the flagged fallback (CAPTURE_SUPERVISED=0, the default
+# here, preserves the battle-tested behavior for `bash tools/bench_capture.sh`).
+if [ "${CAPTURE_SUPERVISED:-0}" = 1 ]; then
+  exec python tools/supervise.py --capture
+fi
+
 OUT=${OUT:-BENCH_auto_r05.json}
 OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r05.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_auto_r05.json}

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""faultline — reproduce any injected-fault scenario from the CLI.
+
+Runs a small CPU training loop with a named FaultPlan wired in, speaking
+the supervisor's exit-code protocol, so every resilience scenario is one
+command (and one tier-1-safe smoke test):
+
+  python tools/faultline.py --plan preempt --steps 8 --workdir /tmp/fl
+  # SIGTERM at a seed-drawn mid-run step -> snapshot saved -> exit 143
+  python tools/faultline.py --plan preempt --steps 8 --workdir /tmp/fl
+  # resumes from the snapshot, finishes, exit 0
+
+Plans (resilience/faults.py NAMED_PLANS): preempt, wedge, nan_loss,
+corrupt_batch, torn_snapshot, none — or explicit specs like
+``preemption@3`` / ``wedge@2:5.0``, comma-separated.  The same
+``(--plan, --steps, --seed)`` triple reproduces the same scenario
+anywhere.  Under the supervisor, faults are TRANSIENT by default: they
+fire on attempt 0 only (SUPERVISE_ATTEMPT), like the real corrupted
+batch or torn write they model.
+
+stdout is one JSON line: status, start/end step, a sha256 digest over
+every state leaf (params, optimizer state, BN stats, RNG, step — the
+cheap cross-process bitwise-parity handle), and the (step, loss) tape.
+Everything else goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _digest(state) -> str:
+    import jax
+    import numpy as np
+
+    from distributedtensorflowexample_tpu.training.checkpoint import (
+        saveable_state_dict)
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(saveable_state_dict(state)):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _batch_stream(batch_size: int, seed: int, start_step: int,
+                  pool_size: int = 4):
+    """Deterministic, step-addressable batches: step s always sees pool
+    slot (s-1) % pool_size, so a resumed run replays the identical
+    stream from its restored step — the dataset-cursor contract the
+    snapshot manifest records (here the cursor IS the step)."""
+    import jax.numpy as jnp
+
+    from distributedtensorflowexample_tpu.data.synthetic import (
+        make_synthetic)
+    x, y = make_synthetic(batch_size * pool_size, (28, 28, 1), 10,
+                          seed=seed + 1)
+    pool = [{"image": jnp.asarray(x[i * batch_size:(i + 1) * batch_size]),
+             "label": jnp.asarray(y[i * batch_size:(i + 1) * batch_size])}
+            for i in range(pool_size)]
+
+    def gen():
+        s = start_step
+        while True:
+            yield pool[s % pool_size]
+            s += 1
+
+    return gen()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--plan", default="preempt",
+                   help="named plan or kind[@step][:arg] specs, "
+                        "comma-separated (see resilience/faults.py)")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--workdir", default="/tmp/faultline",
+                   help="snapshot directory (shared across attempts — "
+                        "this is what resume resumes from)")
+    p.add_argument("--model", default="softmax",
+                   choices=["softmax", "mnist_cnn"])
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--snapshot_every", type=int, default=1)
+    p.add_argument("--keep", type=int, default=3)
+    p.add_argument("--resume", default="true",
+                   help="resume from the latest manifest-valid snapshot")
+    p.add_argument("--transient", default="true",
+                   help="faults fire on SUPERVISE_ATTEMPT=0 only (a "
+                        "retry models recovered hardware); false "
+                        "re-fires every attempt")
+    args = p.parse_args(argv)
+    truthy = lambda v: str(v).lower() in ("1", "true", "t", "yes", "y")
+
+    import jax
+    # Standalone invocations must pin CPU in-process: this image's
+    # sitecustomize force-registers the axon TPU platform and overrides
+    # JAX_PLATFORMS from the environment (see tests/conftest.py) — and a
+    # fault drill must never touch, or wedge on, the real tunnel.
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_train_step)
+    from distributedtensorflowexample_tpu.resilience import (
+        FaultInjectionHook, FaultPlan, FaultyBatches, MetricsTapeHook,
+        NaNGuardHook, SnapshotHook, SnapshotStore)
+    from distributedtensorflowexample_tpu.training.hooks import (
+        HeartbeatHook)
+    from distributedtensorflowexample_tpu.training.loop import TrainLoop
+    from distributedtensorflowexample_tpu.training.state import TrainState
+    from distributedtensorflowexample_tpu.utils.signals import sigterm_flag
+
+    attempt = int(os.environ.get("SUPERVISE_ATTEMPT", "0"))
+    plan = FaultPlan.parse(args.plan, args.steps, args.seed)
+    if plan and truthy(args.transient) and attempt > 0:
+        print(f"faultline: attempt {attempt}: plan {args.plan!r} already "
+              f"fired (transient) — clean run", file=sys.stderr, flush=True)
+        plan = FaultPlan([], seed=args.seed, name=f"{args.plan} (cleared)")
+
+    store = SnapshotStore(os.path.join(args.workdir, "snapshots"),
+                          keep=args.keep)
+    model = build_model(args.model)
+    state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
+                              jnp.zeros((args.batch, 28, 28, 1),
+                                        jnp.float32), seed=args.seed)
+    if truthy(args.resume):
+        state = store.restore(state)
+    start_step = int(state.step)
+    if start_step:
+        print(f"faultline: resumed from snapshot at step {start_step}",
+              file=sys.stderr, flush=True)
+
+    batches = FaultyBatches(
+        _batch_stream(args.batch, args.seed, start_step), plan,
+        start_step=start_step)
+    tape = MetricsTapeHook()
+    # Order is load-bearing: the NaN guard must raise BEFORE SnapshotHook
+    # sees the poisoned step, so no snapshot of a non-finite state ever
+    # reaches disk; FaultInjectionHook goes last so the step that a
+    # preemption/wedge covers is already snapshotted.
+    hooks = [NaNGuardHook(), tape,
+             SnapshotHook(store, every=args.snapshot_every,
+                          cursor={"seed": args.seed}),
+             FaultInjectionHook(plan)]
+    hb = os.environ.get("SUPERVISE_HEARTBEAT", "")
+    if hb:
+        hooks.append(HeartbeatHook(hb))
+
+    def emit(status: str, digest_state=None, **extra) -> None:
+        rec = {"status": status, "plan": args.plan, "seed": args.seed,
+               "attempt": attempt, "start_step": start_step,
+               "losses": [[s, loss] for s, loss in tape.tape], **extra}
+        if digest_state is not None:
+            rec["step"] = int(digest_state.step)
+            rec["digest"] = _digest(digest_state)
+        print(json.dumps(rec, sort_keys=True), flush=True)
+
+    with sigterm_flag() as preempted:
+        loop = TrainLoop(make_train_step(), batches, args.steps,
+                         hooks=hooks, should_stop=preempted)
+        try:
+            state = loop.run(state)
+        except FloatingPointError as e:
+            # The guard fired before the poisoned state could be saved;
+            # the newest snapshot on disk is the last healthy step.  No
+            # digest: the local state reference was donated into the
+            # loop (its buffers are gone), and a poisoned state has no
+            # parity claim to attest anyway.
+            print(f"faultline: {e}", file=sys.stderr, flush=True)
+            emit("fault", error=str(e), step=start_step + len(tape.tape))
+            return 1
+        # Post-exit faults: tear the newest payload AFTER the final save
+        # — the "checkpoint write died mid-file" shape recovery must
+        # survive by falling back to the previous valid snapshot.
+        for spec in plan.post_exit_specs:
+            if spec.step <= int(state.step):
+                torn = store.tear_latest()
+                print(f"faultline: tore snapshot {torn} mid-file",
+                      file=sys.stderr, flush=True)
+        if preempted:
+            emit("preempted", digest_state=state)
+            return 143
+    emit("ok", digest_state=state)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

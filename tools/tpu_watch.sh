@@ -51,7 +51,18 @@ WATCH_LOG=${WATCH_LOG:-/tmp/tpu_watch.log}
 RECOVERED_MARKER=${RECOVERED_MARKER:-/tmp/tpu_recovered}
 CAPTURE_PIDFILE=${CAPTURE_PIDFILE:-/tmp/bench_capture.pid}
 PROBE_INTERVAL_S=${PROBE_INTERVAL_S:-300}
+# Per-probe backend timeout (was hardcoded 300 inline: the round-5 watch
+# log burned exactly 300 s on each of 215 consecutive probes).  Exported
+# so the python snippet below reads the same value the outer timeout is
+# derived from.
+PROBE_TIMEOUT_S=${PROBE_TIMEOUT_S:-300}
+export PROBE_TIMEOUT_S
 STALE_S=${STALE_S:-900}
+# Capture launcher on a recovery edge: "supervised" (default) delegates
+# the 4-phase sequence to tools/supervise.py — journaled resume across
+# windows, wedge-aware phase skipping, bounded phase 4; "bash" is the
+# legacy inline tools/bench_capture.sh fallback.
+CAPTURE_LAUNCHER=${CAPTURE_LAUNCHER:-supervised}
 
 # Liveness + age via ps (empty output = no such process).
 proc_age() { ps -o etimes= -p "$1" 2>/dev/null | tr -d ' '; }
@@ -79,12 +90,17 @@ check_capture() {
     # AND its direct children — killing only the parent would orphan a
     # live bench/profile child that then suppresses the fresh launch as
     # a "young bench" with no parent left to promote its .tmp output.
+    # TERM->KILL grace must OUTLAST the supervised capture's own child
+    # escalation (supervise.py kill_grace_s=30): a SIGTERM'd supervisor
+    # forwards TERM to its child group (own session — the watcher's
+    # group kill can't reach it) and needs its full grace to escalate a
+    # TERM-ignoring child to KILL before we KILL the supervisor itself.
     kids=$(pgrep -P "$cap_pid" 2>/dev/null | tr '\n' ' ')
     echo "$ts killing stale capture group $cap_pid (age ${cap_age}s >" \
          "${kill_over}s; kids: ${kids:-none})" >> "$WATCH_LOG"
     kill -TERM -- "-$cap_pid" 2>/dev/null \
       || kill -TERM "$cap_pid" $kids 2>/dev/null
-    sleep 10
+    sleep "${CAPTURE_KILL_GRACE_S:-35}"
     kill -KILL -- "-$cap_pid" 2>/dev/null \
       || kill -KILL "$cap_pid" $kids 2>/dev/null
     rm -f "$CAPTURE_PIDFILE"
@@ -136,8 +152,13 @@ maybe_launch() {
     return
   fi
   sleep 10
-  echo "$ts launching auto-capture" >> "$WATCH_LOG"
-  setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+  if [ "$CAPTURE_LAUNCHER" = bash ]; then
+    echo "$ts launching auto-capture (bash fallback)" >> "$WATCH_LOG"
+    setsid nohup bash tools/bench_capture.sh > /dev/null 2>&1 &
+  else
+    echo "$ts launching auto-capture (supervised)" >> "$WATCH_LOG"
+    setsid nohup python tools/supervise.py --capture > /dev/null 2>&1 &
+  fi
 }
 
 prev=OK
@@ -146,12 +167,17 @@ fails=0
 fail_start=0
 while true; do
   ts=$(date -u +%H:%M:%S)
-  # -k 10 390: the probe's own worst case is ~335 s (import + 300 s wait
-  # + 30 s SIGTERM grace + SIGKILL); the outer timeout must outlast it
-  # or it orphans a SIGTERM-ignoring child before the SIGKILL escalation.
-  out=$(timeout -k 10 390 python -c "
+  # Outer timeout = PROBE_TIMEOUT_S + 90: the probe's own worst case is
+  # ~timeout+35 s (import + wait + 30 s SIGTERM grace + SIGKILL); the
+  # outer timeout must outlast it or it orphans a SIGTERM-ignoring child
+  # before the SIGKILL escalation.  ${PROBE_TIMEOUT_S%.*}: the python
+  # consumer accepts floats, but bash arithmetic would fatally error on
+  # one — truncate (the +90 margin dwarfs a lost fraction).
+  out=$(timeout -k 10 $((${PROBE_TIMEOUT_S%.*} + 90)) python -c "
+import os
 import bench
-ok, info = bench._probe_backend(timeout_s=300)
+ok, info = bench._probe_backend(
+    timeout_s=float(os.environ.get('PROBE_TIMEOUT_S', 300)))
 print('OK' if ok else 'FAIL', info)
 " 2>/dev/null | tail -1)
   echo "$ts $out" >> "$WATCH_LOG"
