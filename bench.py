@@ -196,25 +196,30 @@ def _wait_for_backend(into: list | None = None) -> tuple[bool, list]:
     if _cpu_pinned():
         attempts.append("probe skipped (cpu platform or BENCH_SKIP_PROBE)")
         return True, attempts
-    deadline = time.time() + RETRY_BUDGET_S
-    while True:
-        t0 = time.time()
-        ok, info = _probe_backend()
-        attempts.append(f"t+{t0 - deadline + RETRY_BUDGET_S:.0f}s: {info}")
-        # stderr heartbeat only — stdout is a pure JSON-lines protocol.
-        print(f"bench: backend probe {attempts[-1]}", file=sys.stderr,
-              flush=True)
-        if ok:
-            return True, attempts
-        # Jittered backoff (resilience round): every supervisor/watcher
-        # retrying a shared tunnel on the same fixed 240-s grid probes in
-        # synchronized bursts — the uniform +/-25% spread decorrelates
-        # them, and the deadline check uses the ACTUAL sleep so the
-        # budget math stays exact.
-        sleep_s = RETRY_INTERVAL_S * (0.75 + 0.5 * random.random())
-        if time.time() + sleep_s + PROBE_TIMEOUT_S > deadline:
-            return False, attempts
-        time.sleep(sleep_s)
+    from distributedtensorflowexample_tpu.obs.trace import span
+    with span("probe") as span_attrs:
+        deadline = time.time() + RETRY_BUDGET_S
+        while True:
+            t0 = time.time()
+            ok, info = _probe_backend()
+            attempts.append(f"t+{t0 - deadline + RETRY_BUDGET_S:.0f}s: {info}")
+            # stderr heartbeat only — stdout is a pure JSON-lines protocol.
+            print(f"bench: backend probe {attempts[-1]}", file=sys.stderr,
+                  flush=True)
+            span_attrs["probes"] = len(attempts)
+            if ok:
+                span_attrs["reachable"] = True
+                return True, attempts
+            # Jittered backoff (resilience round): every supervisor/watcher
+            # retrying a shared tunnel on the same fixed 240-s grid probes in
+            # synchronized bursts — the uniform +/-25% spread decorrelates
+            # them, and the deadline check uses the ACTUAL sleep so the
+            # budget math stays exact.
+            sleep_s = RETRY_INTERVAL_S * (0.75 + 0.5 * random.random())
+            if time.time() + sleep_s + PROBE_TIMEOUT_S > deadline:
+                span_attrs["reachable"] = False
+                return False, attempts
+            time.sleep(sleep_s)
 
 
 def _arm_watchdog(budget_s: float, fire, _exit=os._exit) -> threading.Event:
@@ -233,6 +238,15 @@ def _arm_watchdog(budget_s: float, fire, _exit=os._exit) -> threading.Event:
             try:
                 fire()
                 sys.stdout.flush()
+                # Wedged-dispatch postmortem (no-op unless a recorder
+                # is installed); the record above is already flushed,
+                # so a telemetry failure costs nothing.
+                try:
+                    from distributedtensorflowexample_tpu.obs.recorder \
+                        import dump_global
+                    dump_global("watchdog")
+                except Exception:
+                    pass
             finally:
                 # The exit must survive a failing fire() (e.g. stdout
                 # gone, or a dict mutated mid-serialization): a watchdog
@@ -294,20 +308,29 @@ def _emit(metric: str, per_chip: float, baselines: dict, detail: dict) -> None:
 def _measure(step, ds, state, steps: int, unroll: int,
              warmup_calls: int = 2) -> tuple[float, list, object]:
     """Best-of-REPEATS steady-state rate; each repeat blocks on its own
-    final metrics so a queue flush can't masquerade as throughput."""
-    calls = max(1, steps // unroll)
-    actual_steps = calls * unroll
-    metrics = None
-    for _ in range(warmup_calls):
-        state, metrics = step(state, next(ds))
-    jax.block_until_ready(metrics)
-    rates = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        for _ in range(calls):
+    final metrics so a queue flush can't masquerade as throughput.
+
+    Wrapped in an obs span (stdlib-only import, see obs/): under a
+    supervised capture the span inherits OBS_PHASE from the queue task,
+    so the telemetry names the same phases the capture journal does.
+    The span closes once per MEASUREMENT (never per step) — zero cost
+    on the rates themselves."""
+    from distributedtensorflowexample_tpu.obs.trace import span
+    with span("measure", steps=steps, unroll=unroll) as attrs:
+        calls = max(1, steps // unroll)
+        actual_steps = calls * unroll
+        metrics = None
+        for _ in range(warmup_calls):
             state, metrics = step(state, next(ds))
         jax.block_until_ready(metrics)
-        rates.append(actual_steps / (time.perf_counter() - t0))
+        rates = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                state, metrics = step(state, next(ds))
+            jax.block_until_ready(metrics)
+            rates.append(actual_steps / (time.perf_counter() - t0))
+        attrs["best_steps_per_sec"] = round(max(rates), 1)
     return max(rates), [round(r, 1) for r in rates], state
 
 
@@ -645,6 +668,16 @@ def main() -> None:
                     proc.terminate()
                 except Exception:
                     pass
+            # Flight postmortem before os._exit (which skips atexit).
+            # No-op unless a recorder was installed (supervised runs);
+            # guarded — the record on fd 1 above is already out, and a
+            # telemetry failure must not change the exit code.
+            try:
+                from distributedtensorflowexample_tpu.obs.recorder import (
+                    dump_global)
+                dump_global("sigterm")
+            except Exception:
+                pass
         finally:
             os._exit(143)
 
@@ -676,6 +709,14 @@ def main() -> None:
 
 def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,
               emit_unavailable, final_once, fire_final) -> None:
+    # Supervised runs (and OBS_FLIGHT=1 opt-ins) leave a
+    # flight_<pid>.json postmortem (measure/probe spans + registry)
+    # next to the capture journal; sigterm=False — the record-survival
+    # handler in main() owns SIGTERM and dumps the flight itself before
+    # os._exit (atexit never runs on that path).
+    from distributedtensorflowexample_tpu.obs import (
+        recorder as obs_recorder)
+    obs_recorder.maybe_install(sigterm=False)
     reachable, _ = _wait_for_backend(into=attempts)
     if not reachable:
         final_once(lambda: emit_unavailable(

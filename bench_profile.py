@@ -35,6 +35,8 @@ import traceback
 import jax
 
 import bench
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs.trace import span
 
 
 def _emit(metric: str, value: float, detail: dict) -> None:
@@ -63,6 +65,14 @@ def main() -> None:
                          "this: 128 ResNet steps x 4 runs take tens of "
                          "minutes on the virtual CPU mesh)")
     args = ap.parse_args()
+
+    # Under a supervised capture (or OBS_FLIGHT=1), leave a per-phase
+    # flight postmortem (the spans below share OBS_PHASE with the
+    # capture journal's task).  sigterm default ON: unlike bench.py this
+    # process has no record-survival handler of its own, so without the
+    # chained dump a supervisor wall-timeout TERM would kill it with no
+    # postmortem at all.
+    obs_recorder.maybe_install()
 
     probe_attempts: list = []
 
@@ -123,6 +133,10 @@ def main() -> None:
     HBM_BW = float(os.environ.get("TPU_HBM_BW", 819e9))   # v5e bytes/s
 
     def run_variant(tag, aug):
+        with span(f"profile_{tag}", unroll=args.unroll):
+            return _run_variant_inner(tag, aug)
+
+    def _run_variant_inner(tag, aug):
         from distributedtensorflowexample_tpu.utils.profiling import (
             cost_and_bytes_audit)
         step, ds, state, u = bench._make(
@@ -179,10 +193,11 @@ def main() -> None:
                 try:
                     jax.profiler.start_trace(args.trace_dir)
                     try:
-                        t0 = time.perf_counter()
-                        state, m = step(state, next(ds))
-                        jax.block_until_ready(m)
-                        dt = time.perf_counter() - t0
+                        with span("trace_window", unroll=u):
+                            t0 = time.perf_counter()
+                            state, m = step(state, next(ds))
+                            jax.block_until_ready(m)
+                            dt = time.perf_counter() - t0
                     finally:
                         # Never leave the profiler running: it would skew
                         # the no_augment + roofline rates measured next.
@@ -206,10 +221,11 @@ def main() -> None:
                     }), flush=True)
 
         def run_roofline():
-            roof = bench._roofline_probe(mesh, args.batch_per_chip,
-                                         length=args.roofline_length,
-                                         model_name="resnet20",
-                                         sample=(32, 32, 3), lr=0.1)
+            with span("roofline", length=args.roofline_length):
+                roof = bench._roofline_probe(mesh, args.batch_per_chip,
+                                             length=args.roofline_length,
+                                             model_name="resnet20",
+                                             sample=(32, 32, 3), lr=0.1)
             rates["roofline"] = max(roof)
             _emit("resnet20_roofline", max(roof) / n, {"repeats": roof})
 

@@ -1,0 +1,37 @@
+"""obs/ — unified telemetry: metrics registry, trace spans, flight
+recorder, and exporters for every run on the box.
+
+Rounds 3-5 were one long outage diagnosed by grepping ad-hoc prints out
+of watcher logs; this package is the structured replacement — one
+instrumentation surface shared by trainers, the supervisor, the bench
+family, and the capture queue (the TF-Replicator lesson: one monitoring
+surface for every parallelism mode).
+
+Four cooperating pieces, each usable alone:
+
+- :mod:`.metrics` — process-wide registry of counters/gauges/histograms
+  with labels, monotonic-clock timestamps, and snapshot/delta semantics.
+  The hot path (one counter increment) is lock-free and microbench-
+  guarded below 2 us (tests/test_obs.py).
+- :mod:`.trace` — nestable span API (``with span("dispatch"): ...``)
+  emitting JSONL trace events with step/attempt/phase context picked up
+  from the supervisor's env (``SUPERVISE_ATTEMPT``, ``OBS_PHASE``).
+- :mod:`.recorder` — bounded in-memory flight recorder (ring of recent
+  spans, metric deltas, and the loss-tape tail) that dumps atomically
+  to ``flight_<pid>.json`` on SIGTERM / NaN-guard trip / supervisor
+  escalation, so every dead run leaves a postmortem.
+- :mod:`.export` — Prometheus-textfile and JSONL exporters;
+  ``tools/obs_report.py`` renders any dump as an OUTAGE_r*-style table.
+
+Deliberately **stdlib-only**: importing obs never pulls jax, so
+bench.py's record-survival contract (its SIGTERM handler must be live
+before the first heavyweight import) and the supervisor's lightweight
+process both instrument themselves for free.
+"""
+
+from distributedtensorflowexample_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry, counter, gauge, histogram, registry)
+from distributedtensorflowexample_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder, dump_global, flight_path, install, maybe_install)
+from distributedtensorflowexample_tpu.obs.trace import (  # noqa: F401
+    add_sink, event, remove_sink, span)

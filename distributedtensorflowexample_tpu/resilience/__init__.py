@@ -20,7 +20,7 @@ never block its own tests.
 
 from distributedtensorflowexample_tpu.resilience.faults import (  # noqa: F401
     FAULT_KINDS, FaultInjectionHook, FaultPlan, FaultSpec, FaultyBatches,
-    MetricsTapeHook, NaNGuardHook)
+    MetricsTapeHook, NaNGuardHook, tear_journal)
 from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401
     SnapshotHook, SnapshotStore)
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: F401

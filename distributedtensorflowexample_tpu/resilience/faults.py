@@ -26,6 +26,18 @@ kind                models
                     newest snapshot AFTER the final save (see
                     tools/faultline.py), so recovery must fall back to
                     the previous manifest-valid snapshot
+``heartbeat_flap``  a beat delayed to exactly the supervisor's timeout
+                    edge, measured from the LAST beat (arg = delay
+                    seconds; 0 reads the edge from
+                    ``SUPERVISE_HEARTBEAT_TIMEOUT_S``): the boundary
+                    blocks until the beat file's age reaches the edge,
+                    then touches it — a slow-but-alive run skating the
+                    watchdog line, the near-miss a hard wedge never
+                    exercises
+``journal_torn``    the supervisor's own journal truncated mid-line
+                    (post-exit, like torn_snapshot): ``Journal.replay``
+                    must skip the torn tail and at worst re-run the one
+                    idempotent task whose completion record tore
 ==================  =====================================================
 
 A plan is addressed by ``(text, num_steps, seed)``: unpinned fault steps
@@ -42,6 +54,7 @@ poisons exactly the window that covers it).
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import signal
 import time
@@ -49,12 +62,27 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
-from distributedtensorflowexample_tpu.training.hooks import Hook, _EveryN
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs import trace as obs_trace
+from distributedtensorflowexample_tpu.training.hooks import (
+    Hook, _EveryN, touch_heartbeat)
 
 FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
-               "torn_snapshot")
+               "torn_snapshot", "heartbeat_flap", "journal_torn")
 _BATCH_KINDS = ("nan_loss", "corrupt_batch")
-_POST_EXIT_KINDS = ("torn_snapshot",)
+_POST_EXIT_KINDS = ("torn_snapshot", "journal_torn")
+
+_INJECTED = obs_metrics.counter(
+    "faults_injected_total", "fault-plan specs that fired, by kind")
+
+# heartbeat_flap aims its beat at the watchdog edge MINUS this margin:
+# time.sleep only ever overshoots, so aiming at the edge itself would
+# land the beat strictly past it and a supervisor poll in that overshoot
+# window would kill the child the drill says must survive.  The margin
+# keeps the near-miss deterministic-survivable while staying far inside
+# the supervisor's 0.2-s poll granularity.
+FLAP_EDGE_MARGIN_S = 0.05
 
 # Named plans: the scenario library tools/faultline.py exposes.  A None
 # step is drawn deterministically from the plan seed (one shared anchor
@@ -68,6 +96,13 @@ NAMED_PLANS = {
     "corrupt_batch": [("corrupt_batch", None, 0.0)],
     "torn_snapshot": [("torn_snapshot", None, 0.0),
                       ("preemption", None, 0.0)],
+    # arg 0.0: the flap delay defaults to the supervisor-exported
+    # timeout itself — the exact edge.
+    "heartbeat_flap": [("heartbeat_flap", None, 0.0)],
+    # Paired with a preemption (same anchor step) so a supervised run
+    # HAS a next attempt — the torn journal only matters at replay.
+    "journal_torn": [("journal_torn", None, 0.0),
+                     ("preemption", None, 0.0)],
 }
 
 
@@ -136,6 +171,36 @@ class FaultPlan:
         return cls(specs, seed=seed, name=text)
 
 
+def _mark_fired(spec: FaultSpec, step: int) -> None:
+    """Every fired fault is telemetry: counted by kind and recorded as
+    a zero-duration span, so a flight dump names the injection that
+    preceded the death it documents."""
+    _INJECTED.labels(kind=spec.kind).inc()
+    obs_trace.event("fault", 0.0, kind=spec.kind, step=step)
+
+
+def tear_journal(path: str) -> bool:
+    """Truncate ``path`` mid-way through its LAST line — a journal
+    append that died between bytes (the ``journal_torn`` fault).  The
+    torn tail is exactly what ``supervisor.Journal.replay`` skips; at
+    worst the one task whose completion record tore re-runs, and every
+    capture phase is idempotent by design.  Returns False (no tear) on
+    a missing or empty file."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    body = data.rstrip(b"\n")
+    if not body:
+        return False
+    start = body.rfind(b"\n") + 1
+    cut = start + max(1, (len(body) - start) // 2)
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+    return True
+
+
 class FaultInjectionHook(Hook):
     """Fires loop-level faults at their exact step boundaries.
 
@@ -160,11 +225,51 @@ class FaultInjectionHook(Hook):
             if i in self._fired or step < s.step:
                 continue
             self._fired.add(i)
+            _mark_fired(s, step)
             if s.kind == "wedge":
                 # Blocks without raising — exactly what a dead tunnel
                 # does to a jit call.  The heartbeat goes stale; only an
                 # external watchdog (resilience.supervisor) can act.
                 time.sleep(s.arg)
+            elif s.kind == "heartbeat_flap":
+                # The near-miss: delay the NEXT beat to exactly the
+                # watchdog's timeout edge (arg overrides; 0 reads the
+                # edge the supervisor exported), then beat.  The edge
+                # is measured from the LAST beat — the age the watchdog
+                # actually polls — not from this boundary: the previous
+                # boundary's beat landed a step ago, and sleeping the
+                # full timeout on top of that would blow past the edge
+                # and get the child killed mid-drill.  The staleness
+                # check is strictly `age > timeout`, so a beat landing
+                # ON the edge must survive — this fault is what keeps
+                # that boundary honest.
+                delay = s.arg or float(os.environ.get(
+                    "SUPERVISE_HEARTBEAT_TIMEOUT_S", "0"))
+                if not delay:
+                    # Refused loudly, like nan_loss on uint8 batches: a
+                    # flap with no edge to aim at would sleep 0 s and
+                    # beat into nothing, yet report the drill as fired.
+                    raise ValueError(
+                        "heartbeat_flap has no timeout edge to aim at: "
+                        "pass an explicit delay (heartbeat_flap@N:SECS) "
+                        "or run under the supervisor, which exports "
+                        "SUPERVISE_HEARTBEAT_TIMEOUT_S")
+                hb = os.environ.get("SUPERVISE_HEARTBEAT", "")
+                if not hb:
+                    # Same discipline: without a beat file the "flap"
+                    # would stall the boundary and beat into nothing.
+                    raise ValueError(
+                        "heartbeat_flap has no heartbeat file to beat "
+                        "(SUPERVISE_HEARTBEAT unset) — run under "
+                        "supervise.py with --heartbeat/"
+                        "--heartbeat_timeout_s, or export "
+                        "SUPERVISE_HEARTBEAT")
+                try:
+                    delay -= time.time() - os.path.getmtime(hb)
+                except OSError:
+                    pass        # no beat yet: the full delay IS the edge
+                time.sleep(max(0.0, delay - FLAP_EDGE_MARGIN_S))
+                touch_heartbeat(hb)
             elif s.kind == "preemption":
                 # Through the real signal path, not a direct flag poke:
                 # the handler installation, the cooperative poll, and
@@ -203,6 +308,7 @@ class FaultyBatches:
             if i in self._fired or not (lo <= s.step <= hi):
                 continue
             self._fired.add(i)
+            _mark_fired(s, s.step)
             batch = self._corrupt(batch, s.kind)
         return batch
 
@@ -251,6 +357,10 @@ class NaNGuardHook(Hook):
         if self._due(step):
             loss = float(np.asarray(metrics["loss"]))
             if not np.isfinite(loss):
+                # Dump the flight BEFORE raising: the exception kills
+                # the process, and the poisoned-loss evidence (span
+                # ring, counters, loss tail) is the postmortem.
+                obs_recorder.dump_global("nan_guard")
                 raise FloatingPointError(
                     f"non-finite loss {loss} at step {step} — refusing to "
                     f"snapshot a poisoned state; restart resumes from the "

@@ -47,11 +47,29 @@ import sys
 import time
 from typing import Callable
 
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs import trace as obs_trace
 from distributedtensorflowexample_tpu.utils.signals import (
     installed_signal_handler)
 
 RC_PREEMPTED = 143   # SIGTERM honored, state saved (trainers, bench)
 RC_WEDGED = 3        # bench watchdog: backend provably wedged
+
+# Child-lifecycle telemetry (obs/): what the watcher-log grep
+# archaeology of rounds 3-5 could only approximate.  The heartbeat-age
+# gauge is the live "how close is this child to the kill line" signal;
+# the kill counter is labeled by escalation reason.
+_ATTEMPTS = obs_metrics.counter(
+    "supervisor_attempts_total", "child attempts spawned")
+_EXITS = obs_metrics.counter(
+    "supervisor_child_exits_total",
+    "child attempt outcomes, by rc classification")
+_KILLS = obs_metrics.counter(
+    "supervisor_kills_total", "watchdog group-kills, by reason")
+_HB_AGE = obs_metrics.gauge(
+    "supervisor_heartbeat_age_seconds",
+    "age of the child's newest heartbeat at the last poll")
 
 # Clean preemptions don't consume the crash-retry budget (each one saved
 # state and resumes further along — dropping the run after N of them
@@ -101,11 +119,29 @@ class Journal:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
 
+    @property
+    def path(self) -> str | None:
+        return self._path
+
     def write(self, event: str, **fields) -> None:
         if not self._path:
             return
         rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        # Heal a torn tail BEFORE appending: a journal write that died
+        # mid-line (or the journal_torn fault) leaves no trailing
+        # newline, and appending straight onto the fragment would merge
+        # it with THIS record into one unparseable line — replay would
+        # then lose a live record, not just skip the dead fragment.
+        heal = False
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                heal = f.read(1) != b"\n"
+        except (OSError, ValueError):
+            pass    # missing or empty file: nothing to heal
         with open(self._path, "a") as f:
+            if heal:
+                f.write("\n")
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
@@ -149,6 +185,19 @@ class Supervisor:
         self._rng = random.Random(seed)
 
     # --- one attempt ------------------------------------------------------
+    def _escalated(self, why: str) -> None:
+        """A watchdog kill is exactly the moment a postmortem matters:
+        the CHILD is wedged (it can't dump its own flight), so the
+        supervisor — the one process still alive and informed — counts
+        the kill and dumps ITS flight (heartbeat-age gauge, attempt
+        counters, span ring) if one is installed (tools/supervise.py)."""
+        _KILLS.labels(why=why).inc()
+        # final=False: the supervisor usually OUTLIVES the escalation
+        # (retry loop, next queue task), and the atexit dump must still
+        # refresh the flight with the true final state — a flight frozen
+        # at attempt 1 of 3 would contradict the journal it cross-checks.
+        obs_recorder.dump_global(f"escalation_{why}", final=False)
+
     def _kill_group(self, proc: subprocess.Popen) -> None:
         """SIGTERM the whole group, grace, then SIGKILL — the same
         escalation tpu_watch.sh uses; the grace period is what lets a
@@ -208,11 +257,13 @@ class Supervisor:
                     _log(f"supervisor SIGTERM — forwarding to child group "
                          f"{proc.pid} and stopping")
                     self._kill_group(proc)
+                    self._escalated("supervisor_sigterm")
                     return None, "supervisor_sigterm"
                 if wall_timeout_s and now - start > wall_timeout_s:
                     _log(f"wall timeout {wall_timeout_s:.0f}s — killing "
                          f"group {proc.pid}")
                     self._kill_group(proc)
+                    self._escalated("wall_timeout")
                     return None, "wall_timeout"
                 if self.heartbeat_timeout_s and heartbeat_path:
                     # Armed only once the FIRST beat lands: heartbeat
@@ -229,12 +280,15 @@ class Supervisor:
                                   - os.path.getmtime(heartbeat_path))
                     except OSError:
                         hb_age = None       # no first beat: not armed
+                    if hb_age is not None:
+                        _HB_AGE.set(round(hb_age, 3))
                     if (hb_age is not None
                             and hb_age > self.heartbeat_timeout_s):
                         _log(f"heartbeat stale {hb_age:.1f}s > "
                              f"{self.heartbeat_timeout_s:.0f}s — killing "
                              f"group {proc.pid} (wedged dispatch)")
                         self._kill_group(proc)
+                        self._escalated("heartbeat_timeout")
                         return None, "heartbeat_timeout"
                 time.sleep(self.poll_s)
 
@@ -273,11 +327,27 @@ class Supervisor:
         failures = 0    # crash-budget counter; preemptions excluded
         while attempt < self.policy.retries + MAX_PREEMPTIONS:
             attempt += 1
+            _ATTEMPTS.inc()
             env = dict(os.environ)
             # The attempt counter lets a child treat injected faults as
             # transient (fire on attempt 0 only) and lets logs attribute
             # output to the retry that produced it.
             env["SUPERVISE_ATTEMPT"] = str(attempt)
+            # Telemetry context for the child's obs surface: spans and
+            # flight dumps carry the task name as their phase (what
+            # makes the capture journal and the telemetry agree), the
+            # heartbeat-flap fault reads the exact watchdog edge, and
+            # journal_torn finds the journal it tears.
+            env.setdefault("OBS_PHASE", name)
+            if self.heartbeat_timeout_s and heartbeat_path:
+                # Exported only when a beat PATH exists too: the
+                # watchdog never arms without one, and advertising an
+                # edge no one is watching would let a heartbeat_flap
+                # drill stall against nothing and claim success.
+                env["SUPERVISE_HEARTBEAT_TIMEOUT_S"] = str(
+                    self.heartbeat_timeout_s)
+            if self.journal.path:
+                env.setdefault("SUPERVISE_JOURNAL", self.journal.path)
             if heartbeat_path:
                 env["SUPERVISE_HEARTBEAT"] = heartbeat_path
             if env_extra:
@@ -311,6 +381,12 @@ class Supervisor:
                     os.remove(tmp)
             self.journal.write("attempt_end", task=name, attempt=attempt,
                                rc=rc, reason=reason)
+            _EXITS.labels(outcome=(
+                "ok" if rc == 0 else
+                "terminated" if reason == "supervisor_sigterm" else
+                "wedged" if rc == RC_WEDGED else
+                "preempted" if rc == RC_PREEMPTED else
+                "killed" if rc is None else "crash")).inc()
             last_rc = rc
             reasons.append(f"attempt {attempt}: rc={rc} ({reason})")
             if rc == 0:
@@ -400,12 +476,15 @@ class TaskQueue:
                 continue
             if task.pre is not None:
                 task.pre()
-            res = self._sup.run(task.argv, name=task.name,
-                                stdout_path=task.stdout_path,
-                                stderr_path=task.stderr_path,
-                                heartbeat_path=task.heartbeat_path,
-                                env_extra=task.env,
-                                wall_timeout_s=task.wall_timeout_s)
+            with obs_trace.span("task", task=task.name) as attrs:
+                res = self._sup.run(task.argv, name=task.name,
+                                    stdout_path=task.stdout_path,
+                                    stderr_path=task.stderr_path,
+                                    heartbeat_path=task.heartbeat_path,
+                                    env_extra=task.env,
+                                    wall_timeout_s=task.wall_timeout_s)
+                attrs["status"] = res.status
+                attrs["attempts"] = res.attempts
             if res.status == "ok":
                 if task.post is not None:
                     task.post()

@@ -449,6 +449,18 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         from distributedtensorflowexample_tpu.training.hooks import (
             HeartbeatHook)
         hooks.append(HeartbeatHook(hb_path, every=_CONSENSUS_POLL_STEPS))
+    # Telemetry (obs/): the registry feed is always on — its boundary
+    # cost is the lock-free path, microbench-guarded in tests/test_obs.py
+    # — while the flight recorder (a flight_<pid>.json postmortem on
+    # every death) arms for supervised runs automatically and for
+    # anything else via OBS_FLIGHT=1.
+    from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+    from distributedtensorflowexample_tpu.training.hooks import MetricsHook
+    hooks.append(MetricsHook(every=cfg.log_every))
+    rec = obs_recorder.maybe_install()
+    if rec is not None:
+        rec.note(trainer=model_name, dataset=dataset_name,
+                 sync_mode=cfg.sync_mode, log_dir=cfg.log_dir)
 
     with sigterm_flag() as preempted:
         with mesh:
@@ -480,6 +492,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                     print(f"SIGTERM at step {int(state.step)}: {saved}; "
                           f"exiting 143", flush=True)
                 logger.close()
+                # Explicit dump (not just atexit): the postmortem should
+                # say PREEMPTED, with the final step/loss already rung.
+                obs_recorder.dump_global("preempted")
                 raise SystemExit(143)
             final_acc = eval_fn(state)
 

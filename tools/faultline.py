@@ -11,7 +11,8 @@ command (and one tier-1-safe smoke test):
   # resumes from the snapshot, finishes, exit 0
 
 Plans (resilience/faults.py NAMED_PLANS): preempt, wedge, nan_loss,
-corrupt_batch, torn_snapshot, none — or explicit specs like
+corrupt_batch, torn_snapshot, heartbeat_flap, journal_torn, none — or
+explicit specs like
 ``preemption@3`` / ``wedge@2:5.0``, comma-separated.  The same
 ``(--plan, --steps, --seed)`` triple reproduces the same scenario
 anywhere.  Under the supervisor, faults are TRANSIENT by default: they
@@ -108,18 +109,28 @@ def main(argv: list[str] | None = None) -> int:
     import optax
 
     from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
     from distributedtensorflowexample_tpu.parallel.sync import (
         make_train_step)
     from distributedtensorflowexample_tpu.resilience import (
         FaultInjectionHook, FaultPlan, FaultyBatches, MetricsTapeHook,
         NaNGuardHook, SnapshotHook, SnapshotStore)
+    from distributedtensorflowexample_tpu.resilience.faults import (
+        tear_journal)
     from distributedtensorflowexample_tpu.training.hooks import (
-        HeartbeatHook)
+        HeartbeatHook, MetricsHook)
     from distributedtensorflowexample_tpu.training.loop import TrainLoop
     from distributedtensorflowexample_tpu.training.state import TrainState
     from distributedtensorflowexample_tpu.utils.signals import sigterm_flag
 
     attempt = int(os.environ.get("SUPERVISE_ATTEMPT", "0"))
+    # Supervised drills leave a flight_<pid>.json postmortem per attempt
+    # (OBS_FLIGHT=1 opts a bare run in) — the cross-check surface for
+    # the supervisor journal + snapshot manifest (tests/test_obs.py).
+    rec = obs_recorder.maybe_install(sigterm=False)
+    if rec is not None:
+        rec.note(tool="faultline", plan=args.plan, model=args.model,
+                 workdir=args.workdir)
     plan = FaultPlan.parse(args.plan, args.steps, args.seed)
     if plan and truthy(args.transient) and attempt > 0:
         print(f"faultline: attempt {attempt}: plan {args.plan!r} already "
@@ -143,11 +154,13 @@ def main(argv: list[str] | None = None) -> int:
         _batch_stream(args.batch, args.seed, start_step), plan,
         start_step=start_step)
     tape = MetricsTapeHook()
-    # Order is load-bearing: the NaN guard must raise BEFORE SnapshotHook
-    # sees the poisoned step, so no snapshot of a non-finite state ever
-    # reaches disk; FaultInjectionHook goes last so the step that a
+    # Order is load-bearing: MetricsHook first so the flight recorder
+    # rings every step's loss INCLUDING a poisoned one (the evidence);
+    # then the NaN guard, which must raise BEFORE SnapshotHook sees the
+    # poisoned step, so no snapshot of a non-finite state ever reaches
+    # disk; FaultInjectionHook goes last so the step that a
     # preemption/wedge covers is already snapshotted.
-    hooks = [NaNGuardHook(), tape,
+    hooks = [MetricsHook(every=1), NaNGuardHook(), tape,
              SnapshotHook(store, every=args.snapshot_every,
                           cursor={"seed": args.seed}),
              FaultInjectionHook(plan)]
@@ -178,15 +191,27 @@ def main(argv: list[str] | None = None) -> int:
             print(f"faultline: {e}", file=sys.stderr, flush=True)
             emit("fault", error=str(e), step=start_step + len(tape.tape))
             return 1
-        # Post-exit faults: tear the newest payload AFTER the final save
-        # — the "checkpoint write died mid-file" shape recovery must
-        # survive by falling back to the previous valid snapshot.
+        # Post-exit faults: applied AFTER the final save — the torn
+        # snapshot/journal shapes recovery must survive by falling back
+        # (previous valid snapshot; journal replay skipping the tail).
         for spec in plan.post_exit_specs:
-            if spec.step <= int(state.step):
+            if spec.step > int(state.step):
+                continue
+            if spec.kind == "torn_snapshot":
                 torn = store.tear_latest()
                 print(f"faultline: tore snapshot {torn} mid-file",
                       file=sys.stderr, flush=True)
+            elif spec.kind == "journal_torn":
+                jp = os.environ.get("SUPERVISE_JOURNAL", "")
+                if jp and tear_journal(jp):
+                    print(f"faultline: tore journal {jp} mid-line",
+                          file=sys.stderr, flush=True)
+                else:
+                    print("faultline: journal_torn had no journal to "
+                          "tear (SUPERVISE_JOURNAL unset or empty) — "
+                          "no-op", file=sys.stderr, flush=True)
         if preempted:
+            obs_recorder.dump_global("preempted")
             emit("preempted", digest_state=state)
             return 143
     emit("ok", digest_state=state)

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""obs_report — render flight-recorder telemetry as OUTAGE_r*-style markdown.
+
+  python tools/obs_report.py /tmp/flight_1234.json
+  python tools/obs_report.py --dir /tmp/supervise_capture_flight \
+      --journal /tmp/supervise_capture.jsonl
+
+Reads the ``flight_<pid>.json`` dumps the obs recorder leaves behind
+(one per dead run; see distributedtensorflowexample_tpu/obs/) and
+prints, per file: run identity (pid/attempt/phase/reason), the counter
+table, gauges, the last spans, and the loss-tape tail.  With
+``--journal`` it also renders the supervisor journal's attempt history,
+so one page answers the questions rounds 3-5 needed grep archaeology
+for: what died, at which step, on which attempt, after which phase.
+
+Stdlib-only and read-only: safe to run on the box mid-outage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _table(headers: list[str], rows: list[list]) -> list[str]:
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    out += ["| " + " | ".join(str(c) for c in row) + " |" for row in rows]
+    return out
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_flight(path: str, flight: dict, max_spans: int = 12,
+                  max_loss: int = 8) -> str:
+    lines = [f"## Flight — `{os.path.basename(path)}`", ""]
+    meta = [("reason", flight.get("reason")),
+            ("pid", flight.get("pid")),
+            ("attempt", flight.get("attempt")),
+            ("phase", flight.get("phase")),
+            ("start_unix", flight.get("start_unix")),
+            ("argv", " ".join(flight.get("argv", []) or []) or None)]
+    meta += sorted((flight.get("notes") or {}).items())
+    lines += [f"- **{k}**: {v}" for k, v in meta if v is not None]
+
+    metrics = flight.get("metrics") or {}
+    counters = metrics.get("counters") or {}
+    if counters:
+        lines += ["", "### Counters", ""]
+        lines += _table(["counter", "value"],
+                        [[f"`{k}`", _fmt_num(v)]
+                         for k, v in sorted(counters.items())])
+    gauges = metrics.get("gauges") or {}
+    if gauges:
+        ts = metrics.get("monotonic_ts")
+        lines += ["", "### Gauges", ""]
+        rows = []
+        for k, g in sorted(gauges.items()):
+            age = ("" if ts is None or g.get("monotonic_ts") is None
+                   else f"{ts - g['monotonic_ts']:.3f}")
+            rows.append([f"`{k}`", _fmt_num(g.get("value")), age])
+        lines += _table(["gauge", "value", "age_s"], rows)
+
+    spans = flight.get("spans") or []
+    if spans:
+        lines += ["", f"### Last spans ({min(len(spans), max_spans)} of "
+                      f"{len(spans)} recorded)", ""]
+        rows = []
+        for ev in spans[-max_spans:]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("name", "t0_s", "dur_s", "depth",
+                                  "parent", "attempt", "phase")}
+            rows.append([f"`{ev.get('name')}`", ev.get("step", ""),
+                         _fmt_num(ev.get("dur_s", "")),
+                         ev.get("phase", ""),
+                         " ".join(f"{k}={v}" for k, v in sorted(
+                             extra.items()) if k != "step")])
+        lines += _table(["span", "step", "dur_s", "phase", "attrs"], rows)
+
+    loss = flight.get("loss_tail") or []
+    if loss:
+        lines += ["", f"### Loss tail (last {min(len(loss), max_loss)} of "
+                      f"{len(loss)} recorded)", ""]
+        lines += _table(["step", "loss"],
+                        [[s, _fmt_num(v)] for s, v in loss[-max_loss:]])
+    return "\n".join(lines)
+
+
+def render_journal(path: str) -> str:
+    lines = [f"## Supervisor journal — `{os.path.basename(path)}`", ""]
+    rows = []
+    try:
+        with open(path) as f:
+            raw = f.readlines()
+    except OSError as e:
+        return "\n".join(lines + [f"- unreadable: {e}"])
+    for line in raw:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rows.append(["(torn line — skipped on replay)", "", "", "", ""])
+            continue
+        rows.append([rec.get("event", ""), rec.get("task", ""),
+                     rec.get("attempt", ""), rec.get("rc", ""),
+                     rec.get("reason", rec.get("why", ""))])
+    lines += _table(["event", "task", "attempt", "rc", "reason"], rows)
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("flights", nargs="*",
+                   help="flight_<pid>.json files to render")
+    p.add_argument("--dir", default="",
+                   help="also render every flight_*.json in this "
+                        "directory (OBS_DIR of the run)")
+    p.add_argument("--journal", default="",
+                   help="supervisor JSONL journal to render alongside")
+    p.add_argument("--max_spans", type=int, default=12)
+    p.add_argument("--max_loss", type=int, default=8)
+    args = p.parse_args(argv)
+
+    paths = list(args.flights)
+    if args.dir:
+        paths += sorted(glob.glob(os.path.join(args.dir, "flight_*.json")))
+    if not paths and not args.journal:
+        p.error("nothing to render: pass flight files, --dir, or --journal")
+
+    sections = ["# Telemetry report", ""]
+    for path in paths:
+        try:
+            with open(path) as f:
+                flight = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            sections.append(f"## Flight — `{os.path.basename(path)}`\n\n"
+                            f"- unreadable: {e}")
+            continue
+        sections.append(render_flight(path, flight,
+                                      max_spans=args.max_spans,
+                                      max_loss=args.max_loss))
+    if args.journal:
+        sections.append(render_journal(args.journal))
+    print("\n\n".join(sections))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

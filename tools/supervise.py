@@ -32,7 +32,9 @@ from __future__ import annotations
 
 import argparse
 import atexit
+import glob
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -42,6 +44,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder  # noqa: E402
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: E402
     Journal, RetryPolicy, Supervisor, Task, TaskQueue)
 
@@ -187,15 +190,33 @@ def run_capture(args) -> int:
     _write_pidfile(pidfile)
     journal_path = os.environ.get("SUPERVISE_JOURNAL",
                                   "/tmp/supervise_capture.jsonl")
+    # Flight files (the supervisor's own + every phase child's) land in
+    # one directory NEXT TO the journal: postmortems archived beside the
+    # provenance record they cross-reference.  Children inherit OBS_DIR;
+    # an operator export of OBS_DIR wins.
+    obs_dir_preset = "OBS_DIR" in os.environ
+    flight_dir = os.environ.setdefault(
+        "OBS_DIR",
+        os.path.splitext(journal_path)[0] + "_flight")
     if _capture_ended(journal_path):
         # Previous window's capture ran to its end (complete OR wedged
         # verdict): rotate it away so THIS edge captures fresh, like the
         # bash path always did — otherwise every later window replays
         # all phases as done_prior and the watcher's once-per-window
-        # capture silently becomes a no-op.
+        # capture silently becomes a no-op.  The flight dir rotates WITH
+        # the journal (only the default dir — an operator's OBS_DIR is
+        # theirs to manage): stale postmortems must not be rendered, or
+        # counted, as this window's, and PID reuse across windows could
+        # even overwrite them.
         os.replace(journal_path, journal_path + ".prev")
+        if not obs_dir_preset and os.path.isdir(flight_dir):
+            shutil.rmtree(flight_dir + ".prev", ignore_errors=True)
+            os.replace(flight_dir, flight_dir + ".prev")
         print(f"supervise: previous capture ended — journal rotated to "
-              f"{journal_path}.prev", file=sys.stderr, flush=True)
+              f"{journal_path}.prev (flight dir alongside)",
+              file=sys.stderr, flush=True)
+    os.makedirs(flight_dir, exist_ok=True)
+    obs_recorder.install(sigterm=False)
     start_ts = time.time()
     journal = Journal(journal_path)
     sup = Supervisor(policy=RetryPolicy(retries=0),  # bench self-retries
@@ -209,10 +230,21 @@ def run_capture(args) -> int:
         # the next window resumes from the first unfinished phase.
         journal.write("capture_end", results=results)
     print(f"supervise: capture done: {results}", file=sys.stderr, flush=True)
+    # The supervisor's own flight is written NOW (not left to atexit)
+    # so the inventory line below counts every file the advertised
+    # obs_report invocation will render.
+    obs_recorder.dump_global("capture_end")
+    flights = sorted(glob.glob(os.path.join(flight_dir, "flight_*.json")))
+    print(f"supervise: {len(flights)} flight file(s) in {flight_dir} — "
+          f"render with: python tools/obs_report.py --dir {flight_dir} "
+          f"--journal {journal_path}", file=sys.stderr, flush=True)
     return 3 if "wedged" in results.values() else 0
 
 
 def run_command(args, argv: list[str]) -> int:
+    # The supervisor's own flight (attempt counters, heartbeat-age
+    # gauge, escalation reason) — written on watchdog kills and exit.
+    obs_recorder.install(sigterm=False)
     sup = Supervisor(
         policy=RetryPolicy(retries=args.retries,
                            backoff_base_s=args.backoff_base_s,
