@@ -40,6 +40,18 @@ class ClusterInfo:
         return self.num_processes > 1
 
 
+def tf_config_env(workers: list[str], index: int,
+                  task_type: str = "worker") -> str:
+    """Serialize the reference-style ``TF_CONFIG`` for worker ``index``
+    — the inverse of :func:`_from_tf_config`, kept in this module so
+    the writer and the parser can't drift.  The fleet supervisor
+    (resilience/fleet.py) exports exactly this to every rank it
+    launches, so a child trainer resolves the same ``ClusterInfo`` a
+    hand-launched worker with the documented env surface would."""
+    return json.dumps({"cluster": {"worker": list(workers)},
+                       "task": {"type": task_type, "index": index}})
+
+
 def _from_tf_config() -> ClusterInfo | None:
     raw = os.environ.get("TF_CONFIG", "")
     if not raw:

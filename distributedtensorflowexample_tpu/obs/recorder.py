@@ -83,8 +83,16 @@ def flight_dir() -> str:
 
 
 def flight_path(pid: int | None = None) -> str:
-    return os.path.join(flight_dir(),
-                        f"flight_{os.getpid() if pid is None else pid}.json")
+    """``flight_<pid>.json`` — or ``flight_<rank>_<pid>.json`` when the
+    process has a rank (``OBS_RANK``, exported by the fleet supervisor
+    and by distributed trainers from their resolved ``ClusterInfo``):
+    N ranks of one gang attempt may recycle pids across restarts, and a
+    multi-process postmortem must never have two ranks' flights collide
+    on (or be attributed by) pid alone."""
+    pid = os.getpid() if pid is None else pid
+    rank = os.environ.get("OBS_RANK", "")
+    name = f"flight_{rank}_{pid}.json" if rank else f"flight_{pid}.json"
+    return os.path.join(flight_dir(), name)
 
 
 class FlightRecorder:
@@ -99,6 +107,7 @@ class FlightRecorder:
         self._start_unix = round(time.time(), 3)
         self._attempt = os.environ.get("SUPERVISE_ATTEMPT")
         self._phase = os.environ.get("OBS_PHASE")
+        self._rank = os.environ.get("OBS_RANK")
         self.dumped = False
 
     # --- record (ring) ----------------------------------------------------
@@ -117,18 +126,21 @@ class FlightRecorder:
 
     # --- dump -------------------------------------------------------------
     def payload(self, reason: str) -> dict:
-        attempt = self._attempt
-        if attempt is not None:
+        def _as_int(v):
+            if v is None:
+                return None
             try:
-                attempt = int(attempt)
+                return int(v)
             except ValueError:
-                pass
+                return v
+
         return {"version": FLIGHT_VERSION,
                 "reason": reason,
                 "pid": os.getpid(),
                 "argv": list(sys.argv),
                 "start_unix": self._start_unix,
-                "attempt": attempt,
+                "attempt": _as_int(self._attempt),
+                "rank": _as_int(self._rank),
                 "phase": self._phase,
                 "notes": dict(self._notes),
                 "spans": list(self._spans),
@@ -146,6 +158,11 @@ class FlightRecorder:
         flight that stopped at attempt 1 of 3 would contradict the very
         journal it exists to cross-check."""
         path = path or flight_path()
+        # The dump dir may not exist yet (a fleet child inherits an
+        # OBS_DIR its supervisor named but never had to create): a
+        # postmortem silently lost to ENOENT — dump_global swallows the
+        # OSError — is the one failure mode this module must not have.
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         # default=str: a foreign scalar (numpy/jax) in a span attr or
         # note serializes as its string form — one forgotten cast must
         # not cost the whole postmortem (dump_global would swallow the

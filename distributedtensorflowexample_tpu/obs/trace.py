@@ -69,6 +69,16 @@ def _context() -> dict:
     phase = os.environ.get("OBS_PHASE")
     if phase:
         ctx["phase"] = phase
+    # Rank context (OBS_RANK: fleet supervisor / distributed trainers):
+    # spans from N ranks of one gang land in N flight files, and the
+    # per-rank timeline obs_report renders needs each event to say
+    # whose it is without joining on pid.
+    rank = os.environ.get("OBS_RANK")
+    if rank:
+        try:
+            ctx["rank"] = int(rank)
+        except ValueError:
+            ctx["rank"] = rank
     return ctx
 
 

@@ -13,6 +13,10 @@ Three cooperating pieces, each usable alone:
 - :mod:`.supervisor` — runs any entrypoint under a heartbeat watchdog
   with exponential backoff + jitter, bounded retries, and a journaled
   priority task queue that survives the supervisor's own death.
+- :mod:`.fleet` — gang supervision over N-process clusters: per-rank
+  heartbeats, whole-gang teardown on any rank loss, and gang restarts
+  from the maximum common valid snapshot step (the resume-step
+  agreement that keeps a restarted fleet bitwise-consistent).
 
 Everything here runs on CPU — the outage this subsystem exists for can
 never block its own tests.
@@ -21,7 +25,10 @@ never block its own tests.
 from distributedtensorflowexample_tpu.resilience.faults import (  # noqa: F401
     FAULT_KINDS, FaultInjectionHook, FaultPlan, FaultSpec, FaultyBatches,
     MetricsTapeHook, NaNGuardHook, tear_journal)
+from distributedtensorflowexample_tpu.resilience.fleet import (  # noqa: F401
+    FleetSupervisor, GangResult, RankLossRefused,
+    RankLossStructurallyIllegal, RankLostError)
 from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401
-    SnapshotHook, SnapshotStore)
+    SnapshotHook, SnapshotStore, newest_common_step, valid_steps)
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: F401
     RetryPolicy, SupervisedResult, Supervisor, Task, TaskQueue)

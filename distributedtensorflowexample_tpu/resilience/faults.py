@@ -38,12 +38,25 @@ kind                models
                     (post-exit, like torn_snapshot): ``Journal.replay``
                     must skip the torn tail and at worst re-run the one
                     idempotent task whose completion record tore
+``kill``            a hard host/process loss — SIGKILL to self at the
+                    boundary: no cooperative save, no exit hooks, no
+                    flight dump (SIGKILL cannot be caught).  What
+                    distinguishes a lost rank from a clean preemption;
+                    the gang-supervision drill's "kill rank 1 at
+                    step 37" (resilience/fleet.py)
 ==================  =====================================================
 
 A plan is addressed by ``(text, num_steps, seed)``: unpinned fault steps
 are drawn from ``random.Random`` seeded with those, so the same CLI line
 reproduces the same scenario anywhere (tools/faultline.py), and a
 different seed explores a different schedule with no code change.
+
+Multi-process drills add per-rank targeting: a spec may carry
+``rank=N`` (CLI grammar ``kind[@step][:arg][%rank]``, e.g.
+``kill@37%1`` = "kill rank 1 at step 37"), and each rank filters the
+shared plan text through :meth:`FaultPlan.for_rank` — every rank parses
+the SAME text with the SAME seed, so unpinned steps land on the same
+anchor fleet-wide and the scenario stays one reproducible triple.
 
 Loop-level faults ride the Hook surface (training/hooks.py); batch-level
 faults wrap the batch iterator (FaultyBatches mirrors TrainLoop's
@@ -69,7 +82,7 @@ from distributedtensorflowexample_tpu.training.hooks import (
     Hook, _EveryN, touch_heartbeat)
 
 FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
-               "torn_snapshot", "heartbeat_flap", "journal_torn")
+               "torn_snapshot", "heartbeat_flap", "journal_torn", "kill")
 _BATCH_KINDS = ("nan_loss", "corrupt_batch")
 _POST_EXIT_KINDS = ("torn_snapshot", "journal_torn")
 
@@ -111,6 +124,7 @@ class FaultSpec:
     kind: str
     step: int           # global step the fault fires at (boundary/window)
     arg: float = 0.0    # kind-specific (wedge: seconds to block)
+    rank: int | None = None   # None = every rank; N = that rank only
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -118,6 +132,8 @@ class FaultSpec:
                              f"(one of {FAULT_KINDS})")
         if self.step < 1:
             raise ValueError(f"fault step {self.step} must be >= 1")
+        if self.rank is not None and self.rank < 0:
+            raise ValueError(f"fault rank {self.rank} must be >= 0")
 
 
 class FaultPlan:
@@ -145,13 +161,24 @@ class FaultPlan:
     def post_exit_specs(self) -> list[FaultSpec]:
         return [s for s in self.specs if s.kind in _POST_EXIT_KINDS]
 
+    def for_rank(self, rank: int) -> "FaultPlan":
+        """This rank's view of a fleet-shared plan: specs pinned to
+        another rank drop out; unpinned (rank=None) specs apply
+        everywhere.  Every rank filters the SAME parsed plan, so the
+        shared seed anchor stays identical fleet-wide — 'kill rank 1 at
+        the seed-drawn step' names one step, not one per rank."""
+        keep = [s for s in self.specs if s.rank is None or s.rank == rank]
+        return FaultPlan(keep, seed=self.seed,
+                         name=f"{self.name}[rank {rank}]")
+
     @classmethod
     def parse(cls, text: str, num_steps: int, seed: int = 0) -> "FaultPlan":
         """Build a plan from CLI text: comma-separated tokens, each a
-        named plan from NAMED_PLANS or ``kind[@step][:arg]`` (e.g.
-        ``preemption@3`` or ``wedge:5.0``).  Unpinned steps share one
-        anchor drawn deterministically from ``(text, num_steps, seed)``
-        in ``[1, num_steps-1]`` — mid-run, never the final step, so
+        named plan from NAMED_PLANS or ``kind[@step][:arg][%rank]``
+        (e.g. ``preemption@3``, ``wedge:5.0``, ``kill@37%1`` = kill
+        rank 1 at step 37).  Unpinned steps share one anchor drawn
+        deterministically from ``(text, num_steps, seed)`` in
+        ``[1, num_steps-1]`` — mid-run, never the final step, so
         there is always work left for the recovery to prove itself on."""
         rng = random.Random(f"{text}|{num_steps}|{seed}")
         anchor = rng.randrange(1, max(2, num_steps))
@@ -162,12 +189,14 @@ class FaultPlan:
                     specs.append(FaultSpec(kind, anchor if step is None
                                            else step, arg))
                 continue
-            body, _, argtxt = token.partition(":")
+            body, _, ranktxt = token.partition("%")
+            body, _, argtxt = body.partition(":")
             kind, _, steptxt = body.partition("@")
             specs.append(FaultSpec(
                 kind, int(steptxt) if steptxt else anchor,
                 float(argtxt) if argtxt else
-                (2.0 if kind == "wedge" else 0.0)))
+                (2.0 if kind == "wedge" else 0.0),
+                rank=int(ranktxt) if ranktxt else None))
         return cls(specs, seed=seed, name=text)
 
 
@@ -275,6 +304,13 @@ class FaultInjectionHook(Hook):
                 # the handler installation, the cooperative poll, and
                 # the save-on-exit are all under test.
                 signal.raise_signal(signal.SIGTERM)
+            elif s.kind == "kill":
+                # A lost host, not a preemption: SIGKILL is uncatchable,
+                # so no save-on-exit, no exit hooks, no flight dump run
+                # — recovery must come entirely from what was already on
+                # disk (the snapshot this boundary's SnapshotHook wrote
+                # before this hook fired) plus an external supervisor.
+                os.kill(os.getpid(), signal.SIGKILL)
         return False
 
 

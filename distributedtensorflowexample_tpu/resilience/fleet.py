@@ -1,0 +1,596 @@
+"""Gang supervision: fleet-level fault tolerance for multi-process runs.
+
+The single-child supervisor (resilience/supervisor.py) restarts ONE
+process; the paper's whole subject is a cluster of them — PS/worker
+``ClusterSpec`` processes whose failure TF-Replicator (arXiv:1902.00465)
+and TensorFlow (arXiv:1605.08695) both treat as a CLUSTER-level event:
+detect, tear down the gang, restart from a mutually consistent
+checkpoint.  This module is that layer.
+
+State machine (one "gang attempt" = one co-scheduled launch of all
+surviving ranks)::
+
+    launch gang (rank r: own process group; env: TF_CONFIG via
+      cluster.tf_config_env, OBS_RANK=r, FLEET_NUM_RANKS,
+      SUPERVISE_ATTEMPT=a, SUPERVISE_HEARTBEAT=<per-rank beat file>,
+      FLEET_RESUME_STEP=<agreed step, once an agreement pass ran>)
+      └─ monitor: per-rank exit | per-rank heartbeat age | wall clock
+           ├─ all ranks rc 0            → ok
+           ├─ all ranks rc ∈ {0, 143},
+           │   some 143                 → clean preemption: gang
+           │                              restarts NOW, exempt from the
+           │                              retry budget (MAX_PREEMPTIONS
+           │                              backstop only)
+           ├─ any rank rc 3             → backend wedged → STOP
+           ├─ any rank crashes/killed   → TEAR DOWN THE WHOLE GANG
+           │                              (TERM-grace-KILL per process
+           │                              group), budgeted gang restart
+           ├─ a rank's heartbeat stale  → same teardown ("wedged rank")
+           ├─ some ranks 143 but others
+           │   still running past the
+           │   preempt grace            → "preempt divergence": the gang
+           │                              lost a member cleanly but NOT
+           │                              unanimously — budgeted restart
+           └─ spawn fails (OSError)     → rank permanently LOST: named
+                                          error (see below), never a
+                                          silent shrink
+
+Resume-step agreement (the restart half): each rank snapshots
+independently (resilience/snapshot.py), so after an unclean gang death
+the per-rank newest steps diverge — the killed rank stopped at k, a
+survivor ran to k+m before teardown, a torn final write fails
+validation.  Before every relaunch the fleet reads every rank's
+manifests, takes the **maximum common valid step**
+(``snapshot.newest_common_step``), DISCARDS every newer snapshot on
+every rank (``SnapshotStore.discard_newer`` — an abandoned timeline
+must not poison the next recovery), and exports the agreed step as
+``FLEET_RESUME_STEP`` to every child, so all ranks resume the same
+global step and the resumed run is bitwise-identical to an
+uninterrupted one.  No common step → ``FLEET_RESUME_STEP=0`` (fresh
+start, all snapshots discarded).
+
+Rank-loss taxonomy — a host that cannot be respawned degrades LOUDLY:
+
+- :class:`RankLossStructurallyIllegal` when the run's state is
+  worker-tiled (``sync_mode=async``): the leading worker axis is
+  structural (trainers/common.py refuses the same restore by name), so
+  restarting with fewer workers is not a degraded run, it is a
+  DIFFERENT program.
+- :class:`RankLossRefused` when fewer workers would be legal
+  (sync-replicated state) but ``elastic`` was not requested: silently
+  shrinking changes the global batch and the data order mid-training.
+- with ``elastic=True`` (and replicated state) the fleet drops the
+  lost rank, rebuilds TF_CONFIG from the survivors, and restarts the
+  gang through the normal budgeted path.
+
+Everything here is CPU-testable with real OS processes — the same
+two-process pattern tests/test_multihost.py uses, no TPU required.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from distributedtensorflowexample_tpu.cluster import tf_config_env
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs import trace as obs_trace
+from distributedtensorflowexample_tpu.resilience.supervisor import (
+    MAX_PREEMPTIONS, RC_PREEMPTED, RC_WEDGED, Journal, RetryPolicy,
+    Supervisor, export_prometheus_collector)
+from distributedtensorflowexample_tpu.utils.signals import (
+    installed_signal_handler)
+
+
+def _log(msg: str) -> None:
+    print(f"fleet: {msg}", file=sys.stderr, flush=True)
+
+# Fleet-level telemetry: the counters the ISSUE names, plus per-rank
+# exit/heartbeat detail — what a fleet operator greps OBS_PROM_DIR for.
+_GANG_RESTARTS = obs_metrics.counter(
+    "fleet_gang_restarts_total",
+    "whole-gang teardown+relaunch cycles (crash-budgeted and preempted)")
+_RANKS_LOST = obs_metrics.counter(
+    "fleet_ranks_lost_total", "ranks whose host could not be respawned")
+_AGREEMENTS = obs_metrics.counter(
+    "fleet_resume_step_agreements_total",
+    "resume-step agreement passes run before a gang relaunch")
+_RANK_EXITS = obs_metrics.counter(
+    "fleet_rank_exits_total", "per-rank attempt outcomes, by rank and class")
+_KILLS = obs_metrics.counter(
+    "fleet_kills_total", "gang teardowns, by reason")
+_HB_AGE = obs_metrics.gauge(
+    "fleet_rank_heartbeat_age_seconds",
+    "age of each live rank's newest heartbeat at the last poll")
+
+
+class RankLostError(RuntimeError):
+    """A rank's host is permanently gone (its respawn failed)."""
+
+    def __init__(self, rank: int, attempt: int, cause: str, msg: str):
+        self.rank = rank
+        self.attempt = attempt
+        self.cause = cause
+        super().__init__(msg)
+
+
+class RankLossStructurallyIllegal(RankLostError):
+    """Fewer workers would change the STATE LAYOUT, not just the speed:
+    async local-SGD state is worker-tiled (leading axis = num_workers —
+    the same topology fact trainers/common.py refuses to restore across
+    by name), so a shrunken gang cannot load any surviving snapshot."""
+
+    def __init__(self, rank: int, attempt: int, cause: str):
+        super().__init__(rank, attempt, cause, (
+            f"rank {rank} permanently lost at gang attempt {attempt} "
+            f"({cause}) and this run's state is worker-tiled "
+            f"(sync_mode=async): the leading worker axis is structural "
+            f"— restarting with fewer workers is ILLEGAL, not degraded "
+            f"(see trainers/common.py's num_workers restore refusal). "
+            f"Re-provision the host, or start fresh on the smaller "
+            f"fleet with a new workdir"))
+
+
+class RankLossRefused(RankLostError):
+    """Fewer workers would be legal (sync-replicated state restores
+    across mesh sizes) but was not requested: a silent shrink changes
+    the global batch and the data order mid-training."""
+
+    def __init__(self, rank: int, attempt: int, cause: str):
+        super().__init__(rank, attempt, cause, (
+            f"rank {rank} permanently lost at gang attempt {attempt} "
+            f"({cause}); sync-replicated state COULD legally continue "
+            f"on fewer workers, but that silently changes the global "
+            f"batch and the data order mid-training — refused without "
+            f"--elastic"))
+
+
+@dataclasses.dataclass
+class GangResult:
+    status: str                  # ok | exhausted | wedged | terminated
+    gang_attempts: int           # launches, including the first
+    restarts: int                # teardown+relaunch cycles (all causes)
+    preemptions: int             # clean unanimous-143 restarts (exempt)
+    agreed_steps: list           # agreement outcomes, in relaunch order
+    last_rcs: dict               # rank -> rc of the final gang attempt
+    ranks: list                  # surviving rank ids
+    reasons: list[str] = dataclasses.field(default_factory=list)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _classify(rc: int | None) -> str:
+    if rc == 0:
+        return "ok"
+    if rc == RC_PREEMPTED:
+        return "preempted"
+    if rc == RC_WEDGED:
+        return "wedged"
+    if rc is None or rc < 0:
+        return "killed"
+    return "crash"
+
+
+class FleetSupervisor:
+    """Launch and babysit an N-rank gang; see the module docstring for
+    the state machine.  ``workdir`` holds per-rank heartbeat files and
+    stderr logs; ``worker_tiled``/``elastic`` select the rank-loss
+    reaction."""
+
+    def __init__(self, num_ranks: int,
+                 policy: RetryPolicy | None = None,
+                 journal: Journal | None = None,
+                 heartbeat_timeout_s: float = 0.0,
+                 wall_timeout_s: float = 0.0,
+                 kill_grace_s: float = 10.0,
+                 poll_s: float = 0.1,
+                 preempt_grace_s: float = 30.0,
+                 seed: int | None = None,
+                 elastic: bool = False,
+                 worker_tiled: bool = False,
+                 workdir: str = "/tmp/fleet"):
+        if num_ranks < 1:
+            raise ValueError(f"num_ranks {num_ranks} must be >= 1")
+        self.num_ranks = num_ranks
+        self.ranks = list(range(num_ranks))     # survivors, original ids
+        self.policy = policy or RetryPolicy()
+        self.journal = journal or Journal(None)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.wall_timeout_s = wall_timeout_s
+        self.kill_grace_s = kill_grace_s
+        self.poll_s = poll_s
+        self.preempt_grace_s = preempt_grace_s
+        self.elastic = elastic
+        self.worker_tiled = worker_tiled
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self._rng = random.Random(seed)
+        # One port per ORIGINAL rank, chosen once: a gang restart reuses
+        # the same coordinator address, like a real re-scheduled job
+        # whose hosts keep their endpoints.
+        self._ports = [_free_port() for _ in range(num_ranks)]
+
+    # --- per-rank plumbing ------------------------------------------------
+    @staticmethod
+    def _sub(argv: list[str], rank: int, num_ranks: int) -> list[str]:
+        """Substitute ``{rank}``/``{num_ranks}`` in child argv tokens —
+        how ONE command line fans out to per-rank workdirs/flags
+        (plain str.replace, not str.format: a child argv may carry
+        braces of its own, e.g. inline JSON)."""
+        return [t.replace("{rank}", str(rank))
+                 .replace("{num_ranks}", str(num_ranks)) for t in argv]
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.workdir, f"hb_rank{rank}")
+
+    def _spawn_rank(self, rank: int, index: int, hosts: list[str],
+                    argv: list[str], name: str, attempt: int,
+                    agreed: int | None, stdout_dir: str | None,
+                    env_extra: dict | None) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["TF_CONFIG"] = tf_config_env(hosts, index)
+        env["OBS_RANK"] = str(rank)
+        env["FLEET_NUM_RANKS"] = str(len(self.ranks))
+        env["SUPERVISE_ATTEMPT"] = str(attempt)
+        env.setdefault("OBS_PHASE", name)
+        if agreed is not None:
+            # Only once an agreement pass ran: the FIRST launch has
+            # nothing to agree on (fresh stores), and a child seeing no
+            # export restores its own newest — which is then provably
+            # common, because nothing has diverged yet.
+            env["FLEET_RESUME_STEP"] = str(agreed)
+        else:
+            # Scrubbed, not inherited: a stale export leaking in from
+            # the FLEET's environment (a prior drill's shell, an outer
+            # harness) would pin every first-attempt child to a step
+            # its fresh store cannot prove — a gang-wide crash loop.
+            env.pop("FLEET_RESUME_STEP", None)
+        hb = self._hb_path(rank)
+        try:
+            # Same stale-mtime reset as the single-child supervisor: a
+            # beat file from the previous attempt would read as an
+            # instant wedge.
+            os.remove(hb)
+        except OSError:
+            pass
+        env["SUPERVISE_HEARTBEAT"] = hb
+        if self.heartbeat_timeout_s:
+            env["SUPERVISE_HEARTBEAT_TIMEOUT_S"] = str(
+                self.heartbeat_timeout_s)
+        if self.journal.path:
+            env.setdefault("SUPERVISE_JOURNAL", self.journal.path)
+        if env_extra:
+            env.update(env_extra)
+        out = err = None
+        try:
+            # stderr appends across attempts (one log per rank, like the
+            # supervisor's `2>> $LOG`); stdout is per-attempt — a gang
+            # drill needs EVERY attempt's JSON tail, not just the last.
+            err = open(os.path.join(self.workdir, f"rank{rank}.log"), "ab")
+            if stdout_dir:
+                os.makedirs(stdout_dir, exist_ok=True)
+                out = open(os.path.join(
+                    stdout_dir, f"rank{rank}_attempt{attempt}.out"), "wb")
+            # {num_ranks} reflects the LIVE gang size (an elastic
+            # restart shrank it), matching the FLEET_NUM_RANKS and
+            # TF_CONFIG this same spawn exports — a child sharding by
+            # the substituted value must divide by the ranks that
+            # actually exist.
+            return subprocess.Popen(
+                self._sub(argv, rank, len(self.ranks)), env=env,
+                stdout=out or err, stderr=err, start_new_session=True)
+        finally:
+            # Popen dup'd the fds (or raised); ours must not leak.
+            for f in (out, err):
+                if f is not None:
+                    f.close()
+
+    # --- gang teardown ----------------------------------------------------
+    def _teardown(self, procs: dict, exited: dict, why: str, name: str,
+                  attempt: int, rank: int | None = None) -> None:
+        """One rank's failure is a GANG event: TERM every live rank's
+        process group in parallel, give them one shared grace window
+        (cooperative trainers save + exit 143 inside it), then KILL the
+        stragglers — the supervisor's TERM-grace-KILL escalation, fanned
+        out so N ranks pay one grace, not N."""
+        _KILLS.labels(why=why).inc()
+        self.journal.write(
+            "gang_teardown", task=name, attempt=attempt, why=why,
+            **({"rank": rank} if rank is not None else {}))
+        live = [(r, p) for r, p in procs.items() if r not in exited]
+        for _, p in live:
+            try:
+                os.killpg(p.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.monotonic() + self.kill_grace_s
+        for r, p in live:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+            exited[r] = p.returncode
+            self.journal.write("rank_exit", task=name, attempt=attempt,
+                               rank=r, rc=p.returncode, reason="teardown")
+            _RANK_EXITS.labels(rank=r, outcome="torn_down").inc()
+        # The fleet is the informed survivor here (a wedged rank can't
+        # dump its own flight); non-terminal so atexit still refreshes.
+        obs_recorder.dump_global(f"gang_teardown_{why}", final=False)
+
+    # --- one gang attempt -------------------------------------------------
+    def _run_gang(self, argv: list[str], name: str, attempt: int,
+                  agreed: int | None, stdout_dir: str | None,
+                  env_extra: dict | None) -> tuple[str, str, dict]:
+        """Returns (outcome, why, rcs): outcome one of ok | preempted |
+        wedged | crash | terminated | rank_lost."""
+        hosts = [f"127.0.0.1:{self._ports[r]}" for r in self.ranks]
+        procs: dict[int, subprocess.Popen] = {}
+        exited: dict[int, int | None] = {}
+        sigterm_seen: list = []
+
+        def _on_term(signum, frame):
+            sigterm_seen.append(True)
+
+        self.journal.write("gang_start", task=name, attempt=attempt,
+                           ranks=list(self.ranks),
+                           resume_step=agreed)
+        # The handler covers the SPAWN loop too: a SIGTERM landing
+        # between two spawns must still reach the children already
+        # launched into their own sessions — the default disposition
+        # would kill the fleet and orphan them mid-gang.
+        with installed_signal_handler(signal.SIGTERM, _on_term):
+            for index, rank in enumerate(self.ranks):
+                try:
+                    procs[rank] = self._spawn_rank(
+                        rank, index, hosts, argv, name, attempt, agreed,
+                        stdout_dir, env_extra)
+                except OSError as e:
+                    # Permanently lost host: nothing at this rank's argv
+                    # can even exec.  Tear down whatever already
+                    # launched, then degrade LOUDLY per the taxonomy.
+                    self._teardown(procs, exited, "rank_lost", name,
+                                   attempt, rank=rank)
+                    _RANKS_LOST.inc()
+                    self.journal.write("rank_lost", task=name,
+                                       attempt=attempt, rank=rank,
+                                       error=str(e))
+                    if self.worker_tiled:
+                        raise RankLossStructurallyIllegal(rank, attempt,
+                                                          str(e)) from e
+                    if not self.elastic:
+                        raise RankLossRefused(rank, attempt, str(e)) from e
+                    self.ranks.remove(rank)
+                    if not self.ranks:
+                        raise RankLossRefused(rank, attempt, str(e)) from e
+                    _log(f"{name}: rank {rank} lost ({e}); elastic — "
+                         f"continuing with ranks {self.ranks}")
+                    return "rank_lost", f"rank {rank} lost: {e}", exited
+
+            start = time.monotonic()
+            first_143_t: float | None = None
+            while True:
+                for r, p in procs.items():
+                    if r in exited:
+                        continue
+                    rc = p.poll()
+                    if rc is not None:
+                        exited[r] = rc
+                        self.journal.write("rank_exit", task=name,
+                                           attempt=attempt, rank=r, rc=rc)
+                        _RANK_EXITS.labels(rank=r,
+                                           outcome=_classify(rc)).inc()
+                live = [r for r in procs if r not in exited]
+                crashed = [r for r, rc in exited.items()
+                           if rc not in (0, RC_PREEMPTED)]
+                if not live:
+                    rcs = set(exited.values())
+                    if rcs == {0}:
+                        return "ok", "all ranks done", exited
+                    if RC_WEDGED in rcs:
+                        return ("wedged",
+                                f"rank(s) {sorted(r for r in exited if exited[r] == RC_WEDGED)} "
+                                f"reported the backend wedged (rc=3)",
+                                exited)
+                    if rcs <= {0, RC_PREEMPTED}:
+                        # Unanimous-clean: every rank either finished or
+                        # preempted-with-save (a finished rank has
+                        # nothing left to preempt) — the 143 consensus
+                        # path, exempt from the retry budget.
+                        return "preempted", "clean preemption", exited
+                    return ("crash", f"rank(s) {sorted(crashed)} crashed "
+                            f"(rcs {[exited[r] for r in sorted(crashed)]})",
+                            exited)
+                if sigterm_seen:
+                    # The fleet itself is being killed: forward to every
+                    # rank group so no child outlives its supervisor.
+                    self._teardown(procs, exited, "fleet_sigterm", name,
+                                   attempt)
+                    return "terminated", "fleet SIGTERM — forwarded", exited
+                if crashed:
+                    self._teardown(procs, exited, "rank_crash", name,
+                                   attempt, rank=crashed[0])
+                    if any(exited[r] == RC_WEDGED for r in crashed):
+                        return ("wedged", f"rank {crashed[0]} rc=3 — gang "
+                                f"torn down", exited)
+                    return ("crash", f"rank {crashed[0]} "
+                            f"rc={exited[crashed[0]]} — gang torn down",
+                            exited)
+                preempted_now = [r for r, rc in exited.items()
+                                 if rc == RC_PREEMPTED]
+                if preempted_now and first_143_t is None:
+                    first_143_t = time.monotonic()
+                if (first_143_t is not None
+                        and time.monotonic() - first_143_t
+                        > self.preempt_grace_s):
+                    # A real platform preemption TERMs every rank; one
+                    # rank exiting 143 while the rest train on is the
+                    # gang cleanly losing a member — NOT the unanimous
+                    # path, so it goes through the budgeted teardown.
+                    self._teardown(procs, exited, "preempt_divergence",
+                                   name, attempt, rank=preempted_now[0])
+                    return ("crash", f"rank(s) {preempted_now} preempted "
+                            f"but rank(s) {live} ran past the "
+                            f"{self.preempt_grace_s:.0f}s consensus grace",
+                            exited)
+                now = time.monotonic()
+                if self.wall_timeout_s and now - start > self.wall_timeout_s:
+                    self._teardown(procs, exited, "wall_timeout", name,
+                                   attempt)
+                    return ("crash", f"wall timeout "
+                            f"{self.wall_timeout_s:.0f}s", exited)
+                if self.heartbeat_timeout_s:
+                    for r in live:
+                        # Armed per rank once ITS first beat lands —
+                        # same opt-in rule as the single-child
+                        # supervisor (a beat-less child is the wall
+                        # timeout's job).
+                        try:
+                            hb_age = (time.time() - os.path.getmtime(
+                                self._hb_path(r)))
+                        except OSError:
+                            continue
+                        _HB_AGE.labels(rank=r).set(round(hb_age, 3))
+                        if hb_age > self.heartbeat_timeout_s:
+                            self._teardown(procs, exited, "rank_heartbeat",
+                                           name, attempt, rank=r)
+                            return ("crash", f"rank {r} heartbeat stale "
+                                    f"{hb_age:.1f}s > "
+                                    f"{self.heartbeat_timeout_s:.0f}s",
+                                    exited)
+                time.sleep(self.poll_s)
+
+    # --- resume-step agreement --------------------------------------------
+    def _agree(self, name: str, snapshot_dir_template: str) -> int | None:
+        """The agreement pass: max common valid step across every
+        surviving rank's store, divergent/torn newest steps discarded
+        from disk, result journaled — returns the step to export (0 =
+        no common step: fresh start), or None when the run has no
+        snapshot surface to agree over."""
+        if not snapshot_dir_template:
+            return None
+        from distributedtensorflowexample_tpu.resilience import (
+            snapshot as snap)
+        dirs = {r: snapshot_dir_template.replace("{rank}", str(r))
+                for r in self.ranks}
+        # One validation pass (full payload read + crc32 per snapshot)
+        # serves both the journal detail and the intersection — this is
+        # newest_common_step's exact rule computed from the per-rank
+        # lists already in hand, not a second disk walk.
+        per_rank = {r: snap.valid_steps(d) for r, d in dirs.items()}
+        common = set.intersection(*(set(v) for v in per_rank.values()))
+        agreed = max(common) if common else 0
+        discarded = {r: snap.SnapshotStore(d).discard_newer(agreed)
+                     for r, d in dirs.items()}
+        _AGREEMENTS.inc()
+        self.journal.write(
+            "resume_agreement", task=name, agreed=agreed,
+            per_rank={str(r): v for r, v in per_rank.items()},
+            discarded={str(r): v for r, v in discarded.items()})
+        _log(f"{name}: resume-step agreement: "
+             + ", ".join(f"rank {r} had {per_rank[r] or 'nothing'}"
+                         for r in sorted(per_rank))
+             + f" -> agreed step {agreed}"
+             + (f" (discarded {discarded})" if any(discarded.values())
+                else ""))
+        return agreed
+
+    # --- the gang retry loop ----------------------------------------------
+    def run(self, argv: list[str], name: str = "",
+            snapshot_dir_template: str = "",
+            stdout_dir: str | None = None,
+            env_extra: dict | None = None) -> GangResult:
+        """Supervise ``argv`` (with ``{rank}`` substitution) as an
+        N-rank gang until it completes, exhausts the crash budget, or
+        loses a host.  ``snapshot_dir_template`` names each rank's
+        SnapshotStore directory (``{rank}`` substituted) — without it
+        no agreement pass runs and restarts are fresh-per-child."""
+        name = name or Supervisor._default_name(argv)
+        attempt = -1
+        failures = 0
+        preemptions = 0
+        restarts = 0
+        agreed: int | None = None
+        agreed_steps: list = []
+        reasons: list[str] = []
+        last: dict = {}
+        try:
+            with obs_trace.span("fleet", task=name,
+                                ranks=self.num_ranks) as attrs:
+                while attempt < self.policy.retries + MAX_PREEMPTIONS:
+                    attempt += 1
+                    outcome, why, last = self._run_gang(
+                        argv, name, attempt, agreed, stdout_dir, env_extra)
+                    reasons.append(f"gang attempt {attempt}: {outcome} "
+                                   f"({why})")
+                    self.journal.write(
+                        "gang_end", task=name, attempt=attempt,
+                        outcome=outcome, why=why,
+                        rcs={str(r): rc for r, rc in sorted(last.items())})
+                    if outcome == "ok":
+                        attrs["status"] = "ok"
+                        return GangResult("ok", attempt + 1, restarts,
+                                          preemptions, agreed_steps, last,
+                                          list(self.ranks), reasons)
+                    if outcome == "terminated":
+                        attrs["status"] = "terminated"
+                        return GangResult("terminated", attempt + 1,
+                                          restarts, preemptions,
+                                          agreed_steps, last,
+                                          list(self.ranks), reasons)
+                    if outcome == "wedged":
+                        # The backend is provably gone under EVERY rank
+                        # of this gang; relaunching N processes against
+                        # a dead tunnel resolves nothing (supervisor
+                        # rc=3 contract).
+                        attrs["status"] = "wedged"
+                        return GangResult("wedged", attempt + 1, restarts,
+                                          preemptions, agreed_steps, last,
+                                          list(self.ranks), reasons)
+                    if outcome == "preempted":
+                        preemptions += 1
+                        _log(f"{name}: gang preempted cleanly — "
+                             f"restarting (exempt from the retry budget)")
+                    else:
+                        # crash / rank_lost(elastic): budgeted.
+                        failures += 1
+                        if failures > self.policy.retries:
+                            attrs["status"] = "exhausted"
+                            return GangResult(
+                                "exhausted", attempt + 1, restarts,
+                                preemptions, agreed_steps, last,
+                                list(self.ranks), reasons)
+                    restarts += 1
+                    _GANG_RESTARTS.inc()
+                    agreed = self._agree(name, snapshot_dir_template)
+                    agreed_steps.append(agreed)
+                    if outcome not in ("preempted", "rank_lost"):
+                        delay = self.policy.delay_s(max(0, failures - 1),
+                                                    self._rng.random())
+                        if delay:
+                            _log(f"{name}: gang restart "
+                                 f"{failures}/{self.policy.retries} in "
+                                 f"{delay:.2f}s (resume step {agreed})")
+                            time.sleep(delay)
+                attrs["status"] = "exhausted"
+                return GangResult("exhausted", attempt + 1, restarts,
+                                  preemptions, agreed_steps, last,
+                                  list(self.ranks), reasons)
+        finally:
+            self.journal.write("fleet_end", task=name,
+                               attempts=attempt + 1, restarts=restarts)
+            export_prometheus_collector("fleet")

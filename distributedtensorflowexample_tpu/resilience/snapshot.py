@@ -215,6 +215,46 @@ class SnapshotStore:
         _RESTORES.inc()
         return state.replace(**restored)
 
+    def discard_newer(self, step: int) -> list[int]:
+        """Delete every snapshot (payload + manifest) newer than
+        ``step`` — the fleet agreement pass's divergence discard.  A
+        rank that ran AHEAD of the agreed resume step holds snapshots
+        from a timeline the gang is abandoning; leaving them on disk
+        would poison the NEXT recovery (save() dedupes against an
+        existing valid manifest, so the stale future step would never
+        be overwritten by the replayed one, and a later restore would
+        silently jump onto the abandoned timeline).  Returns the
+        discarded steps, ascending."""
+        dropped = []
+        for s in self.steps():
+            if s <= step:
+                continue
+            failed = None
+            for p in (self._payload_path(s), self._manifest_path(s)):
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+                except OSError as e:
+                    failed = e
+            if failed is not None and self.validate(s)[0]:
+                # A still-VALID snapshot the OS would not let us delete
+                # must not be reported discarded: the caller journals
+                # this list as the agreement's proof, and a later
+                # restore-newest would silently jump onto the abandoned
+                # timeline the record claims is gone.  (A half-removed
+                # snapshot that now fails validation is harmless — the
+                # fallback path already skips it.)
+                _log(f"FAILED to discard snapshot {s} ({failed}) — it is "
+                     f"still restorable as newest; fix the store "
+                     f"permissions before trusting a resume from here")
+                continue
+            dropped.append(s)
+        if dropped:
+            _log(f"discarded snapshot(s) {dropped} newer than agreed "
+                 f"step {step} (divergent timeline)")
+        return dropped
+
     # --- fault-injection surface -----------------------------------------
     def tear_latest(self) -> int | None:
         """Truncate the newest payload mid-file (fault injection: a
@@ -229,6 +269,39 @@ class SnapshotStore:
         with open(path, "r+b") as f:
             f.truncate(size // 2)
         return steps[-1]
+
+
+def valid_steps(directory: str) -> list[int]:
+    """Steps in ``directory`` whose payload+manifest pass validation
+    (size + crc32), ascending — one rank's input to the fleet's
+    resume-step agreement.  Reads manifests and payload bytes only,
+    never deserializes state."""
+    store = SnapshotStore(directory)
+    return [s for s in store.steps() if store.validate(s)[0]]
+
+
+def newest_common_step(manifest_dirs: list[str]) -> int | None:
+    """The maximum step EVERY directory holds a valid snapshot for —
+    the gang's agreed resume point (resilience/fleet.py).
+
+    Each rank snapshots independently, so after an unclean gang death
+    the newest steps diverge: the killed rank stopped at k, a survivor
+    ran on to k+m before teardown, and a torn final write fails
+    validation entirely.  Restoring per-rank newest would silently
+    resume DIFFERENT global steps on different ranks (the divergence
+    this helper exists to make visible); the newest COMMON valid step
+    is the latest state the whole fleet can provably agree on, and
+    resuming there is bitwise-identical to an uninterrupted run.
+
+    Returns None when no common valid step exists (some rank has
+    nothing valid) — the gang must start fresh."""
+    common: set[int] | None = None
+    for d in manifest_dirs:
+        steps = set(valid_steps(d))
+        common = steps if common is None else common & steps
+        if not common:
+            return None
+    return max(common) if common else None
 
 
 class SnapshotHook(Hook):

@@ -82,6 +82,46 @@ def _log(msg: str) -> None:
     print(f"supervise: {msg}", file=sys.stderr, flush=True)
 
 
+def kill_process_group(proc: subprocess.Popen, grace_s: float) -> None:
+    """SIGTERM the whole group, grace, then SIGKILL — THE one escalation
+    (tpu_watch.sh's shape), shared by the single-child supervisor and
+    the fleet gang teardown (resilience/fleet.py) so the grace
+    semantics — the window a trainer's SIGTERM handler has to write its
+    final checkpoint — can't drift between the two."""
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=grace_s)
+            return
+        except subprocess.TimeoutExpired:
+            continue
+    proc.wait()
+
+
+def export_prometheus_collector(name: str = "supervise") -> str | None:
+    """Write the metrics registry to ``$OBS_PROM_DIR/<name>.prom`` (the
+    node-exporter textfile-collector dialect) — the round-7 ROADMAP
+    leftover: ``obs.export.write_prometheus_textfile`` was wired and
+    golden-tested but nothing periodic called it.  Now every completed
+    supervisor task (and every fleet gang attempt) refreshes the
+    collector file, so a scraper on the box sees attempt/kill/restart
+    counters without any HTTP server to babysit.  No-op without
+    OBS_PROM_DIR; never raises — telemetry must not kill the run."""
+    directory = os.environ.get("OBS_PROM_DIR", "")
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        from distributedtensorflowexample_tpu.obs import export as obs_export
+        return obs_export.write_prometheus_textfile(
+            os.path.join(directory, f"{name}.prom"))
+    except Exception:
+        return None
+
+
 @dataclasses.dataclass
 class RetryPolicy:
     """Bounded retries with jittered exponential backoff.  Jitter is the
@@ -199,20 +239,7 @@ class Supervisor:
         obs_recorder.dump_global(f"escalation_{why}", final=False)
 
     def _kill_group(self, proc: subprocess.Popen) -> None:
-        """SIGTERM the whole group, grace, then SIGKILL — the same
-        escalation tpu_watch.sh uses; the grace period is what lets a
-        trainer's SIGTERM handler write its final checkpoint."""
-        for sig in (signal.SIGTERM, signal.SIGKILL):
-            try:
-                os.killpg(proc.pid, sig)
-            except (ProcessLookupError, PermissionError):
-                pass
-            try:
-                proc.wait(timeout=self.kill_grace_s)
-                return
-            except subprocess.TimeoutExpired:
-                continue
-        proc.wait()
+        kill_process_group(proc, self.kill_grace_s)
 
     def _run_once(self, argv: list[str], env: dict, stdout_file,
                   stderr_file, heartbeat_path: str | None,
@@ -318,6 +345,21 @@ class Supervisor:
             heartbeat_path: str | None = None,
             env_extra: dict | None = None,
             wall_timeout_s: float | None = None) -> SupervisedResult:
+        try:
+            return self._run(argv, name, stdout_path, stderr_path,
+                             heartbeat_path, env_extra, wall_timeout_s)
+        finally:
+            # Post-task collector refresh (OBS_PROM_DIR): the queue
+            # calls run() once per task, so this IS "after every task"
+            # — and a single supervised command gets the same export.
+            export_prometheus_collector()
+
+    def _run(self, argv: list[str], name: str = "",
+             stdout_path: str | None = None,
+             stderr_path: str | None = None,
+             heartbeat_path: str | None = None,
+             env_extra: dict | None = None,
+             wall_timeout_s: float | None = None) -> SupervisedResult:
         name = name or self._default_name(argv)
         wall = (self.wall_timeout_s if wall_timeout_s is None
                 else wall_timeout_s)

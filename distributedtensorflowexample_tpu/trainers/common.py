@@ -145,6 +145,13 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         print(cluster.PS_NOTICE, flush=True)
         return {"role": "ps", "exited": True}
     cluster.maybe_initialize_distributed(info)
+    if info.is_distributed:
+        # Rank-labeled telemetry: every obs surface (flight filename,
+        # span context — obs/recorder.py, obs/trace.py) reads OBS_RANK.
+        # The fleet supervisor exports it at spawn; a hand-launched
+        # worker gets it here from its resolved cluster identity, so
+        # two ranks' flight files can never collide on pid alone.
+        os.environ.setdefault("OBS_RANK", str(info.process_id))
 
     mesh = make_mesh(cfg.num_devices)
     if jax.process_count() > 1:
@@ -459,6 +466,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     hooks.append(MetricsHook(every=cfg.log_every))
     rec = obs_recorder.maybe_install()
     if rec is not None:
+        # (rank, attempt, phase land in the flight payload itself —
+        # the recorder reads OBS_RANK/SUPERVISE_ATTEMPT/OBS_PHASE.)
         rec.note(trainer=model_name, dataset=dataset_name,
                  sync_mode=cfg.sync_mode, log_dir=cfg.log_dir)
 

@@ -1,0 +1,199 @@
+"""End-to-end gang drills (the ISSUE's ACCEPTANCE criterion): a 2-rank
+sync mnist_cnn fleet where a rank-targeted FaultPlan kills one rank
+mid-run — gang teardown, resume-step agreement, gang restart — and the
+resumed params/opt-state/loss-tape are BITWISE-equal to an
+uninterrupted run, with per-rank flights + the fleet journal
+cross-checking the restart count and the agreed step.
+
+Each rank is a real OS process running tools/faultline.py (a fresh jax
+import per child), so this file runs as an isolated subprocess during
+full-suite runs (tests/isolation_list.py) — wall-time containment, not
+abort risk.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+from distributedtensorflowexample_tpu.resilience.fleet import FleetSupervisor
+from distributedtensorflowexample_tpu.resilience.supervisor import (
+    Journal, RetryPolicy)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAULTLINE = os.path.join(REPO, "tools", "faultline.py")
+
+pytestmark = [pytest.mark.fleet, pytest.mark.faults]
+
+
+def _straight_run(capsys, workdir: str, steps: int) -> dict:
+    """The uninterrupted reference, in-process (shares the warm jit
+    cache): same model/seed/steps, no faults."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import faultline
+    finally:
+        sys.path.pop(0)
+    rc = faultline.main(["--plan", "none", "--steps", str(steps),
+                         "--model", "mnist_cnn", "--workdir", workdir,
+                         "--keep", "10", "--seed", "0"])
+    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert rc == 0
+    return json.loads(out[-1])
+
+
+def _rank_argv(base, plan: str, steps: int) -> list[str]:
+    return [sys.executable, FAULTLINE, "--plan", plan,
+            "--steps", str(steps), "--model", "mnist_cnn",
+            "--workdir", os.path.join(str(base), "rank{rank}"),
+            "--keep", "10", "--seed", "0"]
+
+
+def _journal_events(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _last_json(path: str) -> dict:
+    with open(path) as f:
+        lines = [l for l in f.read().splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+def test_acceptance_rank_kill_gang_restart_bitwise(tmp_path, capsys):
+    """ACCEPTANCE: rank 1 SIGKILLed at step 4 by `kill@4%1` (no save, no
+    exit hooks — a lost host, not a preemption).  The fleet tears down
+    rank 0 (which saves cooperatively under TERM), agrees on the max
+    common valid step, discards rank 0's divergent newer snapshots,
+    restarts the gang with FLEET_RESUME_STEP exported — and every
+    rank's final digest and loss tape reproduce the uninterrupted run
+    exactly."""
+    steps = 8
+    journal_path = str(tmp_path / "fleet.jsonl")
+    flight_dir = str(tmp_path / "flight")
+    fleet = FleetSupervisor(
+        2, policy=RetryPolicy(retries=2, backoff_base_s=0.01,
+                              backoff_max_s=0.02),
+        journal=Journal(journal_path),
+        kill_grace_s=30.0,          # must cover rank 0's save-on-TERM
+        poll_s=0.1, seed=0, workdir=str(tmp_path / "fleet"))
+    res = fleet.run(
+        _rank_argv(tmp_path, "kill@4%1", steps), name="drill",
+        snapshot_dir_template=os.path.join(str(tmp_path), "rank{rank}",
+                                           "snapshots"),
+        stdout_dir=str(tmp_path / "out"),
+        env_extra={"OBS_DIR": flight_dir})
+    assert res.status == "ok", res.reasons
+    assert res.gang_attempts == 2 and res.restarts == 1
+    assert res.last_rcs == {0: 0, 1: 0}
+
+    # the agreement: rank 1 died at 4 with step 4 already snapshotted
+    # (SnapshotHook runs before FaultInjectionHook), rank 0 was torn
+    # down somewhere >= its own last save — agreed step is what the
+    # journal says, and it is a real mid-run step
+    events = _journal_events(journal_path)
+    agree = next(e for e in events if e["event"] == "resume_agreement")
+    agreed = agree["agreed"]
+    assert 1 <= agreed <= 4, agree
+    assert res.agreed_steps == [agreed]
+    assert max(agree["per_rank"]["1"]) == 4     # rank 1's last save
+    # rank 1's SIGKILL death is journaled with its signal rc; when rank
+    # 0 was still mid-run (the usual case) the whole gang was torn down
+    # — but mnist_cnn steps are sub-millisecond post-compile, so rank 0
+    # finishing all 8 inside one poll window is a legal race too.
+    assert any(e["event"] == "rank_exit" and e.get("rank") == 1
+               and e.get("rc") == -9 for e in events)
+    for tear in (e for e in events if e["event"] == "gang_teardown"):
+        assert tear["why"] == "rank_crash" and tear["rank"] == 1
+
+    straight = _straight_run(capsys, str(tmp_path / "straight"), steps)
+
+    for rank in (0, 1):
+        final = _last_json(
+            str(tmp_path / "out" / f"rank{rank}_attempt1.out"))
+        assert final["status"] == "ok" and final["step"] == steps
+        assert final["start_step"] == agreed      # resumed the AGREED step
+        # bitwise: every state leaf (params, opt state, rng, step)
+        assert final["digest"] == straight["digest"], f"rank {rank}"
+        # loss tape: the resumed tape is exactly the straight tape's
+        # suffix past the agreed step
+        assert final["losses"] == straight["losses"][agreed:], f"rank {rank}"
+    # rank 0's first attempt ran PAST the kill (torn down mid-run ->
+    # "preempted", or finished inside the poll window -> "ok"); either
+    # way its emitted tape is a bitwise prefix of the straight tape —
+    # the overlap with the redone steps reproduces exactly
+    first0 = _last_json(str(tmp_path / "out" / "rank0_attempt0.out"))
+    assert first0["status"] in ("preempted", "ok")
+    n = len(first0["losses"])
+    assert n >= 1 and first0["losses"] == straight["losses"][:n]
+
+    # per-rank flights (flight_<rank>_<pid>.json): every rank left at
+    # least one postmortem whose attempt/rank fields line up with the
+    # journal's two gang attempts
+    for rank in (0, 1):
+        flights = [json.load(open(p)) for p in
+                   glob.glob(os.path.join(flight_dir,
+                                          f"flight_{rank}_*.json"))]
+        assert flights, f"rank {rank} left no flight"
+        assert {f["rank"] for f in flights} == {rank}
+        assert max(f["attempt"] for f in flights) == 1
+    # rank 0's attempt-0 flight documents how that attempt ended
+    # ("preempted" when torn down mid-run, "exit" when it finished)
+    r0_reasons = {f["attempt"]: f["reason"] for f in
+                  (json.load(open(p)) for p in
+                   glob.glob(os.path.join(flight_dir, "flight_0_*.json")))}
+    assert r0_reasons.get(0) in ("preempted", "exit")
+
+
+def test_wedged_rank_heartbeat_drill_restarts_bitwise(tmp_path, capsys):
+    """'wedge rank 0's heartbeat': rank 0 blocks in-dispatch at step 3
+    (beats stop, process lives) while rank 1 races ahead; the per-rank
+    watchdog tears the gang down, the agreement rolls rank 1 BACK to
+    rank 0's last provable step (discarding rank 1's newer snapshots),
+    and the restarted gang still lands bitwise on the straight run."""
+    steps = 6
+    journal_path = str(tmp_path / "fleet.jsonl")
+    fleet = FleetSupervisor(
+        2, policy=RetryPolicy(retries=2, backoff_base_s=0.01,
+                              backoff_max_s=0.02),
+        journal=Journal(journal_path),
+        # The timeout must comfortably exceed the child's jax compile
+        # (the stretch between the arming first beat and the first
+        # boundary beat — several seconds here, tens under suite load):
+        # a tight edge kills HEALTHY ranks mid-compile, which is
+        # exactly the supervisor's beat-vs-wall lesson.  The wedge arg
+        # (240 s) must in turn exceed timeout+grace so the watchdog,
+        # not the sleep running out, is what ends the attempt.
+        heartbeat_timeout_s=60.0,
+        # the wedged rank sleeps through TERM (PEP 475 resumes the
+        # sleep), so the grace only delays its SIGKILL — keep it short;
+        # rank 1 is long finished by the time the watchdog fires
+        kill_grace_s=6.0,
+        poll_s=0.1, seed=0, workdir=str(tmp_path / "fleet"))
+    res = fleet.run(
+        _rank_argv(tmp_path, "wedge@3:240%0", steps), name="wedge_drill",
+        snapshot_dir_template=os.path.join(str(tmp_path), "rank{rank}",
+                                           "snapshots"),
+        stdout_dir=str(tmp_path / "out"))
+    assert res.status == "ok", res.reasons
+    assert res.gang_attempts == 2 and res.restarts == 1
+    tear = next(e for e in _journal_events(journal_path)
+                if e["event"] == "gang_teardown")
+    assert tear["why"] == "rank_heartbeat" and tear["rank"] == 0
+    agree = next(e for e in _journal_events(journal_path)
+                 if e["event"] == "resume_agreement")
+    agreed = agree["agreed"]
+    # 0 is legal: rank 1 TERM'd before its first completed step has
+    # nothing valid, and the agreement degrades to a full fresh start
+    assert 0 <= agreed <= 3
+
+    straight = _straight_run(capsys, str(tmp_path / "straight"), steps)
+    for rank in (0, 1):
+        final = _last_json(
+            str(tmp_path / "out" / f"rank{rank}_attempt1.out"))
+        assert final["status"] == "ok" and final["step"] == steps
+        assert final["start_step"] == agreed
+        assert final["digest"] == straight["digest"], f"rank {rank}"
+        assert final["losses"] == straight["losses"][agreed:], f"rank {rank}"

@@ -19,6 +19,14 @@ anywhere.  Under the supervisor, faults are TRANSIENT by default: they
 fire on attempt 0 only (SUPERVISE_ATTEMPT), like the real corrupted
 batch or torn write they model.
 
+Fleet drills (tools/supervise_fleet.py) run one faultline per rank with
+the SAME plan text: a ``%rank`` suffix pins a spec to one rank
+(``kill@5%1`` = kill rank 1 at step 5), and this process keeps only the
+specs for ITS rank (--rank, default OBS_RANK).  When the fleet's
+resume-step agreement exported FLEET_RESUME_STEP, the restore targets
+exactly that step — never this rank's own newest, which may sit on a
+divergent timeline the gang has discarded.
+
 stdout is one JSON line: status, start/end step, a sha256 digest over
 every state leaf (params, optimizer state, BN stats, RNG, step — the
 cheap cross-process bitwise-parity handle), and the (step, loss) tape.
@@ -91,7 +99,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--snapshot_every", type=int, default=1)
     p.add_argument("--keep", type=int, default=3)
     p.add_argument("--resume", default="true",
-                   help="resume from the latest manifest-valid snapshot")
+                   help="resume from the latest manifest-valid snapshot "
+                        "(or from FLEET_RESUME_STEP when a fleet "
+                        "agreement pass exported one)")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this process's rank for %%rank-targeted fault "
+                        "specs (default: OBS_RANK, else 0)")
     p.add_argument("--transient", default="true",
                    help="faults fire on SUPERVISE_ATTEMPT=0 only (a "
                         "retry models recovered hardware); false "
@@ -127,11 +140,22 @@ def main(argv: list[str] | None = None) -> int:
     # Supervised drills leave a flight_<pid>.json postmortem per attempt
     # (OBS_FLIGHT=1 opts a bare run in) — the cross-check surface for
     # the supervisor journal + snapshot manifest (tests/test_obs.py).
+    rank = (args.rank if args.rank is not None
+            else int(os.environ.get("OBS_RANK", "0")))
     rec = obs_recorder.maybe_install(sigterm=False)
     if rec is not None:
         rec.note(tool="faultline", plan=args.plan, model=args.model,
                  workdir=args.workdir)
     plan = FaultPlan.parse(args.plan, args.steps, args.seed)
+    if any(s.rank is not None for s in plan.specs):
+        # Every rank parses the SAME text (same seed anchor), then keeps
+        # only its own specs — "kill rank 1 at step 5" is one shared
+        # scenario, not per-rank guesswork.
+        plan = plan.for_rank(rank)
+        print(f"faultline: rank {rank} plan: "
+              + (", ".join(f"{s.kind}@{s.step}" for s in plan.specs)
+                 or "(no faults target this rank)"),
+              file=sys.stderr, flush=True)
     if plan and truthy(args.transient) and attempt > 0:
         print(f"faultline: attempt {attempt}: plan {args.plan!r} already "
               f"fired (transient) — clean run", file=sys.stderr, flush=True)
@@ -143,8 +167,29 @@ def main(argv: list[str] | None = None) -> int:
     state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
                               jnp.zeros((args.batch, 28, 28, 1),
                                         jnp.float32), seed=args.seed)
+    agreed_txt = os.environ.get("FLEET_RESUME_STEP", "")
     if truthy(args.resume):
-        state = store.restore(state)
+        if agreed_txt:
+            # The fleet's agreement pass picked the max common valid
+            # step and discarded everything newer; restoring this
+            # rank's own newest instead would silently resume a
+            # DIFFERENT global step than the other ranks (the
+            # divergence the agreement exists to prevent).
+            agreed = int(agreed_txt)
+            if agreed > 0:
+                ok, why = store.validate(agreed)
+                if not ok:
+                    print(f"faultline: fleet agreed resume step {agreed} "
+                          f"is not valid in this rank's store ({why}) — "
+                          f"the agreement pass guarantees every rank "
+                          f"holds it; refusing to resume from a "
+                          f"divergent snapshot", file=sys.stderr,
+                          flush=True)
+                    return 1
+                state = store.restore(state, step=agreed)
+            # agreed == 0: no common step existed — start fresh.
+        else:
+            state = store.restore(state)
     start_step = int(state.step)
     if start_step:
         print(f"faultline: resumed from snapshot at step {start_step}",
@@ -170,7 +215,8 @@ def main(argv: list[str] | None = None) -> int:
 
     def emit(status: str, digest_state=None, **extra) -> None:
         rec = {"status": status, "plan": args.plan, "seed": args.seed,
-               "attempt": attempt, "start_step": start_step,
+               "attempt": attempt, "rank": rank,
+               "start_step": start_step,
                "losses": [[s, loss] for s, loss in tape.tape], **extra}
         if digest_state is not None:
             rec["step"] = int(digest_state.step)

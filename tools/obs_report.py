@@ -7,11 +7,13 @@
 
 Reads the ``flight_<pid>.json`` dumps the obs recorder leaves behind
 (one per dead run; see distributedtensorflowexample_tpu/obs/) and
-prints, per file: run identity (pid/attempt/phase/reason), the counter
-table, gauges, the last spans, and the loss-tape tail.  With
-``--journal`` it also renders the supervisor journal's attempt history,
-so one page answers the questions rounds 3-5 needed grep archaeology
-for: what died, at which step, on which attempt, after which phase.
+prints, per file: run identity (pid/rank/attempt/phase/reason), the
+counter table, gauges, the last spans, and the loss-tape tail.  With
+``--journal`` it also renders the supervisor journal's attempt history
+— and, for fleet journals (resilience/fleet.py), a per-rank timeline:
+which rank died first, what tore the gang down, which step the restart
+agreed on — so one page answers the questions rounds 3-5 needed grep
+archaeology for: what died, at which step, on which attempt.
 
 Stdlib-only and read-only: safe to run on the box mid-outage.
 """
@@ -43,6 +45,7 @@ def render_flight(path: str, flight: dict, max_spans: int = 12,
     lines = [f"## Flight — `{os.path.basename(path)}`", ""]
     meta = [("reason", flight.get("reason")),
             ("pid", flight.get("pid")),
+            ("rank", flight.get("rank")),
             ("attempt", flight.get("attempt")),
             ("phase", flight.get("phase")),
             ("start_unix", flight.get("start_unix")),
@@ -93,24 +96,90 @@ def render_flight(path: str, flight: dict, max_spans: int = 12,
     return "\n".join(lines)
 
 
+def _journal_records(path: str):
+    """(records, torn_count) — torn lines are what replay skips."""
+    records, torn = [], 0
+    with open(path) as f:
+        for line in f:
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                torn += 1
+    return records, torn
+
+
 def render_journal(path: str) -> str:
     lines = [f"## Supervisor journal — `{os.path.basename(path)}`", ""]
     rows = []
     try:
-        with open(path) as f:
-            raw = f.readlines()
+        records, torn = _journal_records(path)
     except OSError as e:
         return "\n".join(lines + [f"- unreadable: {e}"])
-    for line in raw:
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            rows.append(["(torn line — skipped on replay)", "", "", "", ""])
-            continue
+    for rec in records:
         rows.append([rec.get("event", ""), rec.get("task", ""),
-                     rec.get("attempt", ""), rec.get("rc", ""),
+                     rec.get("rank", ""), rec.get("attempt", ""),
+                     rec.get("rc", ""),
                      rec.get("reason", rec.get("why", ""))])
-    lines += _table(["event", "task", "attempt", "rc", "reason"], rows)
+    for _ in range(torn):
+        rows.append(["(torn line — skipped on replay)", "", "", "", "", ""])
+    lines += _table(["event", "task", "rank", "attempt", "rc", "reason"],
+                    rows)
+    return "\n".join(lines)
+
+
+_FLEET_EVENTS = ("gang_start", "rank_exit", "rank_lost", "gang_teardown",
+                 "gang_end", "resume_agreement", "fleet_end")
+
+
+def render_fleet_timeline(path: str) -> str:
+    """Per-rank timeline of a fleet run (resilience/fleet.py journal):
+    who died first, what tore the gang down, what step the restart
+    agreed on — the questions a multi-process postmortem starts with.
+    Empty string when the journal has no fleet events (single-child
+    supervisor journals skip the section)."""
+    try:
+        records, _ = _journal_records(path)
+    except OSError:
+        return ""
+    events = [r for r in records if r.get("event") in _FLEET_EVENTS]
+    if not events:
+        return ""
+    t0 = events[0].get("ts") or 0
+    rows = []
+    for r in events:
+        ev = r["event"]
+        if ev == "gang_start":
+            detail = (f"ranks {r.get('ranks')}, resume_step "
+                      f"{r.get('resume_step')}")
+        elif ev == "rank_exit":
+            detail = f"rc={r.get('rc')}" + (
+                f" ({r['reason']})" if r.get("reason") else "")
+        elif ev == "rank_lost":
+            detail = r.get("error", "")
+        elif ev == "gang_teardown":
+            detail = r.get("why", "")
+        elif ev == "gang_end":
+            detail = f"{r.get('outcome')}: {r.get('why')}"
+        elif ev == "resume_agreement":
+            # journal keys are strings: sort ranks numerically so a
+            # 12-rank fleet doesn't render 0, 1, 10, 11, 2, ...
+            per = r.get("per_rank") or {}
+            detail = ("agreed step " + str(r.get("agreed")) + "; " +
+                      ", ".join(f"rank {k}: {v}" for k, v in sorted(
+                          per.items(),
+                          key=lambda kv: (not str(kv[0]).isdigit(),
+                                          int(kv[0])
+                                          if str(kv[0]).isdigit()
+                                          else str(kv[0])))))
+        else:   # fleet_end
+            detail = (f"attempts={r.get('attempts')} "
+                      f"restarts={r.get('restarts')}")
+        ts = r.get("ts")
+        rows.append([("" if ts is None else f"{ts - t0:+.3f}"),
+                     r.get("rank", ""), r.get("attempt", ""), f"`{ev}`",
+                     detail])
+    lines = [f"## Per-rank timeline — `{os.path.basename(path)}`", ""]
+    lines += _table(["t_s", "rank", "attempt", "event", "detail"], rows)
     return "\n".join(lines)
 
 
@@ -146,6 +215,9 @@ def main(argv: list[str] | None = None) -> int:
                                       max_spans=args.max_spans,
                                       max_loss=args.max_loss))
     if args.journal:
+        timeline = render_fleet_timeline(args.journal)
+        if timeline:
+            sections.append(timeline)
         sections.append(render_journal(args.journal))
     print("\n\n".join(sections))
     return 0

@@ -26,6 +26,11 @@ supervised capture exactly like a bash one.  The journal
 never had: phases already recorded done are skipped on relaunch, and a
 wedge verdict (rc=3) persists across supervisor restarts so chip-bound
 phases stay skipped while the CPU-only bytes audit still lands.
+
+Either mode: exporting OBS_PROM_DIR makes every completed task refresh
+<OBS_PROM_DIR>/supervise.prom (node-exporter textfile-collector
+dialect) with the live attempt/kill/heartbeat counters.  For N-process
+gangs, see tools/supervise_fleet.py.
 """
 
 from __future__ import annotations

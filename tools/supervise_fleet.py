@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Run an N-rank gang under the fleet supervisor (resilience/fleet.py):
+per-rank heartbeats, whole-gang teardown on any rank loss, gang restart
+from the agreed (maximum common valid) snapshot step.
+
+  # the ACCEPTANCE drill: 2-rank sync mnist_cnn, rank 1 killed mid-run
+  # by a rank-targeted FaultPlan -> gang teardown -> gang restart from
+  # the agreed step -> bitwise-identical to an uninterrupted run:
+  python tools/supervise_fleet.py --num_ranks 2 --workdir /tmp/fleet -- \\
+      python tools/faultline.py --plan 'kill@5%1' --steps 10 \\
+          --model mnist_cnn --workdir '/tmp/fleet/rank{rank}' --keep 10
+
+  # real trainers get the same env surface the paper's ClusterSpec
+  # launch used (TF_CONFIG per rank; cluster.resolve reads it):
+  python tools/supervise_fleet.py --num_ranks 2 --heartbeat_timeout_s 600 \\
+      --workdir /tmp/fleet -- \\
+      python -m distributedtensorflowexample_tpu.trainers.trainer_sync_mnist \\
+          --dataset synthetic --train_steps 5000 --log_dir /tmp/fleet/shared
+
+Every ``{rank}`` (and ``{num_ranks}``) in the child argv is substituted
+per rank, so one command line fans out to per-rank workdirs.  Exported
+per rank: TF_CONFIG (task index = rank), OBS_RANK, FLEET_NUM_RANKS,
+SUPERVISE_ATTEMPT (the gang attempt), SUPERVISE_HEARTBEAT (+ the
+timeout edge), and — after any restart — FLEET_RESUME_STEP, the agreed
+resume step every rank must restore (0 = start fresh).
+
+Exit codes extend the supervisor protocol: 0 ok, 143 terminated
+(SIGTERM forwarded to every rank group), 3 wedged (some rank reported
+the backend provably gone), 4 rank lost + worker-tiled state (restart
+with fewer workers is structurally illegal), 5 rank lost + refused
+without --elastic, 1 crash budget exhausted.  OBS_PROM_DIR (optional)
+receives a fleet.prom textfile-collector export after every gang
+attempt; per-rank flight files land in OBS_DIR (default
+<workdir>/flight) as flight_<rank>_<pid>.json — render with
+``python tools/obs_report.py --dir <workdir>/flight --journal
+<workdir>/fleet.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder  # noqa: E402
+from distributedtensorflowexample_tpu.resilience.fleet import (  # noqa: E402
+    FleetSupervisor, RankLossRefused, RankLossStructurallyIllegal)
+from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: E402
+    Journal, RetryPolicy)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    child: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, child = argv[:split], argv[split + 1:]
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--num_ranks", type=int, default=2)
+    p.add_argument("--retries", type=int, default=3,
+                   help="gang restarts after crashes (clean unanimous "
+                        "preemptions are exempt)")
+    p.add_argument("--backoff_base_s", type=float, default=1.0)
+    p.add_argument("--backoff_max_s", type=float, default=60.0)
+    p.add_argument("--timeout_s", type=float, default=0.0,
+                   help="wall deadline per gang attempt (0 = none)")
+    p.add_argument("--heartbeat_timeout_s", type=float, default=0.0,
+                   help="tear the gang down when ANY rank's heartbeat "
+                        "goes stale this long (0 = no heartbeat "
+                        "watchdog)")
+    p.add_argument("--kill_grace_s", type=float, default=10.0,
+                   help="TERM-to-KILL grace per teardown (covers the "
+                        "ranks' save-on-exit)")
+    p.add_argument("--preempt_grace_s", type=float, default=30.0,
+                   help="how long a partial 143 may wait for the rest "
+                        "of the gang before it counts as divergence")
+    p.add_argument("--workdir", default="/tmp/fleet",
+                   help="fleet scratch: heartbeats, per-rank logs, "
+                        "journal, flight dir")
+    p.add_argument("--snapshots", default="",
+                   help="per-rank SnapshotStore directory template "
+                        "({rank} substituted) for the resume-step "
+                        "agreement; default <workdir>/rank{rank}/"
+                        "snapshots; pass 'none' to disable")
+    p.add_argument("--journal", default="",
+                   help="fleet journal path (default <workdir>/"
+                        "fleet.jsonl)")
+    p.add_argument("--stdout_dir", default="",
+                   help="per-rank per-attempt child stdout files "
+                        "(default <workdir>)")
+    p.add_argument("--elastic", action="store_true",
+                   help="on a permanently lost rank, continue with the "
+                        "survivors (sync/replicated state only)")
+    p.add_argument("--sync_mode", default="sync", choices=["sync", "async"],
+                   help="what the ranks train: async means worker-tiled "
+                        "state, where restarting with fewer workers is "
+                        "structurally illegal")
+    p.add_argument("--name", default="", help="task name for the journal")
+    p.add_argument("--seed", type=int, default=None,
+                   help="backoff-jitter seed (tests)")
+    args = p.parse_args(argv)
+    if not child:
+        p.error("nothing to run: pass -- CMD ARGS... "
+                "({rank} substituted per rank)")
+
+    workdir = os.path.abspath(args.workdir)
+    snapshots = args.snapshots or os.path.join(workdir,
+                                               "rank{rank}", "snapshots")
+    if snapshots == "none":
+        snapshots = ""
+    # Flight files from every rank (and the fleet's own) in one place,
+    # named flight_<rank>_<pid>.json; an operator export of OBS_DIR wins.
+    os.environ.setdefault("OBS_DIR", os.path.join(workdir, "flight"))
+    os.makedirs(os.environ["OBS_DIR"], exist_ok=True)
+    obs_recorder.install(sigterm=False)
+
+    fleet = FleetSupervisor(
+        args.num_ranks,
+        policy=RetryPolicy(retries=args.retries,
+                           backoff_base_s=args.backoff_base_s,
+                           backoff_max_s=args.backoff_max_s),
+        journal=Journal(args.journal
+                        or os.path.join(workdir, "fleet.jsonl")),
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        wall_timeout_s=args.timeout_s,
+        kill_grace_s=args.kill_grace_s,
+        preempt_grace_s=args.preempt_grace_s,
+        seed=args.seed,
+        elastic=args.elastic,
+        worker_tiled=(args.sync_mode == "async"),
+        workdir=workdir)
+    try:
+        res = fleet.run(child, name=args.name,
+                        snapshot_dir_template=snapshots,
+                        stdout_dir=args.stdout_dir or workdir)
+    except RankLossStructurallyIllegal as e:
+        print(f"supervise_fleet: {e}", file=sys.stderr, flush=True)
+        return 4
+    except RankLossRefused as e:
+        print(f"supervise_fleet: {e}", file=sys.stderr, flush=True)
+        return 5
+    print(f"supervise_fleet: {res.status}: gang_attempts="
+          f"{res.gang_attempts} restarts={res.restarts} "
+          f"preemptions={res.preemptions} agreed_steps={res.agreed_steps} "
+          f"ranks={res.ranks} rcs={res.last_rcs}",
+          file=sys.stderr, flush=True)
+    if res.status == "ok":
+        return 0
+    if res.status == "terminated":
+        return 143
+    if res.status == "wedged":
+        return 3
+    # Exhausted: forward a rank's own positive rc where one exists.
+    # 143 is excluded — that code means "terminated/preempted cleanly"
+    # to any outer supervisor honoring the protocol, and an EXHAUSTED
+    # fleet whose last attempt happened to contain a preempted rank
+    # must not masquerade as one (it would be restarted budget-free
+    # forever).  Signal deaths are negative (waitpid convention) and
+    # would wrap mod 256 — those, 143s, and an empty rc map all report
+    # as a plain crash.
+    bad = [rc for rc in res.last_rcs.values()
+           if rc is not None and 0 < rc < 256 and rc != 143]
+    return bad[0] if bad else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
