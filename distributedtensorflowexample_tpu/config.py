@@ -88,6 +88,16 @@ class RunConfig:
                                     # (arXiv:2004.13336): per-chip weight-
                                     # update bytes drop ~1/D; params stay
                                     # replicated for fwd/bwd (sync mode only)
+    bucket_grads: str = ""          # "" | auto | <bytes> — fuse the
+                                    # per-parameter gradient all-reduces
+                                    # into knee-sized buckets (one psum
+                                    # per bucket; auto = the measured
+                                    # collective knee, bench_collectives).
+                                    # With --shard_update: the explicit
+                                    # per-bucket reduce-scatter + sharded
+                                    # update + all-gather ZeRO-1 schedule.
+                                    # Async mode buckets the worker-
+                                    # average psums.  No BatchNorm models
 
     # --- hand-written TPU kernels (ops/pallas) ---
     pallas_ce: bool = False         # fused Pallas loss head in the train step
@@ -208,6 +218,17 @@ _FLAG_HELP = {
                     "arXiv:2004.13336): each chip updates 1/D of the "
                     "params and the update is all-gathered; params stay "
                     "replicated for compute. Sync mode only",
+    "bucket_grads": "'' | auto | <bytes> — fuse per-parameter gradient "
+                    "all-reduces into buckets of at most this many bytes "
+                    "(strictly fewer, larger collectives; same gradient "
+                    "math — see DESIGN.md §15). auto = sized from the "
+                    "measured collective knee (bench_collectives.py; "
+                    "BUCKET_GRADS_AUTO_BYTES overrides). Composes with "
+                    "--shard_update into the explicit per-bucket "
+                    "reduce-scatter + sharded-update + all-gather ZeRO-1 "
+                    "schedule; in async mode buckets the worker-average "
+                    "psums. Refused by name for BatchNorm models and "
+                    "--fused_optimizer",
     "pallas_ce": "fused Pallas cross-entropy head",
     "fused_optimizer": "fused Pallas momentum-SGD (measured 2.3x slower "
                        "than XLA on v5e — kept as kernel reference; "

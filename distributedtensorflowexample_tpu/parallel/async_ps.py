@@ -120,7 +120,7 @@ def _worker_updates(state: TrainState, loss_rows: Callable, n_workers: int,
 
 def _build_async_step_fn(num_workers: int, period: int,
                          label_smoothing: float = 0.0, ce_impl: str = "xla",
-                         mesh=None) -> Callable:
+                         mesh=None, bucket_bytes: int | None = None) -> Callable:
     """The un-jitted local-SGD (state, batch) -> (state, metrics) body over
     worker-tiled state, shared by the host-fed and indexed factories.
 
@@ -135,7 +135,10 @@ def _build_async_step_fn(num_workers: int, period: int,
     period = max(1, int(period))
     if mesh is not None and mesh.size > 1:
         return _build_shard_map_step(num_workers, period, label_smoothing,
-                                     ce_impl, mesh)
+                                     ce_impl, mesh,
+                                     bucket_bytes=bucket_bytes)
+    # Single device: the worker average is local (no collectives), so
+    # bucket_bytes has nothing to fuse here.
     loss_rows = make_loss_rows(label_smoothing, ce_impl, mesh)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
@@ -174,7 +177,7 @@ def _build_async_step_fn(num_workers: int, period: int,
 
 def _build_shard_map_step(num_workers: int, period: int,
                           label_smoothing: float, ce_impl: str,
-                          mesh) -> Callable:
+                          mesh, bucket_bytes: int | None = None) -> Callable:
     """Multi-device local-SGD step: the per-worker compute runs under
     ``jax.shard_map`` over the worker axis, so every device steps ONLY its
     own workers' parameter copies — zero collectives between averaging
@@ -220,6 +223,23 @@ def _build_shard_map_step(num_workers: int, period: int,
                 labels, rngs)
 
             def average(tree):
+                if bucket_bytes:
+                    # The per-leaf tree psum below is the per-parameter
+                    # collective pattern --bucket_grads fuses: one psum
+                    # per knee-sized bucket of local worker-sums instead
+                    # of one per leaf.  Bitwise: concatenation regroups
+                    # which psum carries each element, never its
+                    # cross-device addition order.
+                    from distributedtensorflowexample_tpu.parallel.bucketing import (
+                        bucketed_tree_psum)
+                    sums = jax.tree.map(
+                        lambda x: jnp.sum(x.astype(jnp.float32), axis=0,
+                                          keepdims=True), tree)
+                    sums = bucketed_tree_psum(sums, bucket_bytes, DATA_AXIS)
+                    return jax.tree.map(
+                        lambda x, s: jnp.broadcast_to(
+                            (s / W).astype(x.dtype), x.shape), tree, sums)
+
                 def avg(x):
                     s = jnp.sum(x.astype(jnp.float32), axis=0, keepdims=True)
                     s = jax.lax.psum(s, DATA_AXIS) / W
@@ -260,17 +280,19 @@ def make_async_train_step(num_workers: int, period: int,
                           label_smoothing: float = 0.0, ce_impl: str = "xla",
                           mesh=None, dequant: str | None = None,
                           dequant_impl: str = "auto",
-                          quantize: str = "auto") -> Callable:
+                          quantize: str = "auto",
+                          bucket_bytes: int | None = None) -> Callable:
     """Build the jitted host-fed local-SGD step over worker-tiled state.
 
     ``dequant``: spec for host-fed uint8 batches (``batcher.dequant``);
     ``dequant_impl``/``quantize``: the in-step dequant kernel knobs,
     resolved by the same rule as every other path (see
-    sync.dequant_host_batch)."""
+    sync.dequant_host_batch).  ``bucket_bytes`` (--bucket_grads) fuses
+    the period-gated worker-average psums into knee-sized buckets."""
     from distributedtensorflowexample_tpu.parallel.sync import (
         dequant_host_batch)
     inner = _build_async_step_fn(num_workers, period, label_smoothing,
-                                 ce_impl, mesh)
+                                 ce_impl, mesh, bucket_bytes=bucket_bytes)
 
     def step(state: TrainState, batch):
         return inner(state, dequant_host_batch(batch, dequant, dequant_impl,
@@ -287,7 +309,8 @@ def make_indexed_async_train_step(num_workers: int, period: int,
                                   augment: str = "none",
                                   num_slots: int | None = None,
                                   data_sharding: str = "replicated",
-                                  dequant_impl: str = "auto") -> Callable:
+                                  dequant_impl: str = "auto",
+                                  bucket_bytes: int | None = None) -> Callable:
     """Local-SGD step over a device-resident dataset — async's analog of
     ``sync.make_indexed_train_step``: same on-device gather from the
     perm ring (multi-epoch fused windows supported), same ``lax.scan``
@@ -298,7 +321,7 @@ def make_indexed_async_train_step(num_workers: int, period: int,
         _resolve_num_slots)
     num_slots = _resolve_num_slots(unroll_steps, steps_per_epoch, num_slots)
     inner = _build_async_step_fn(num_workers, period, label_smoothing,
-                                 ce_impl, mesh)
+                                 ce_impl, mesh, bucket_bytes=bucket_bytes)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
                                 num_slots=num_slots,
                                 data_sharding=data_sharding,

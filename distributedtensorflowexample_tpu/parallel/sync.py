@@ -304,7 +304,9 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
 
 def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
                    mesh=None, num_replicas: int = 1,
-                   replicas_to_aggregate: int = 0) -> Callable:
+                   replicas_to_aggregate: int = 0,
+                   bucket_bytes: int | None = None,
+                   bucket_shard_update: bool = False) -> Callable:
     """The un-jitted (state, batch) -> (state, metrics) step body, shared
     by the plain and the device-resident (indexed) step factories.
 
@@ -327,7 +329,22 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
     Implemented as a per-row weight on the loss, so the gradient psum
     stays the one XLA collective; unselected replicas' rows carry zero
     weight and their gradient contribution vanishes.
+
+    ``bucket_bytes`` (the ``--bucket_grads`` knob) swaps this body for
+    the bucketed shard_map step (parallel/bucketing.py): per-parameter
+    gradient all-reduces fuse into knee-sized buckets, and with
+    ``bucket_shard_update`` the explicit per-bucket reduce-scatter +
+    sharded-update + all-gather ZeRO-1 schedule.  On a single-device
+    mesh there is nothing to reduce, so the knob falls through to this
+    plain body.
     """
+    if bucket_bytes and mesh is not None and mesh.shape[DATA_AXIS] > 1:
+        from distributedtensorflowexample_tpu.parallel.bucketing import (
+            build_bucketed_step_fn)
+        return build_bucketed_step_fn(label_smoothing, ce_impl, mesh,
+                                      num_replicas, replicas_to_aggregate,
+                                      bucket_bytes,
+                                      shard_update=bucket_shard_update)
     R, N = int(replicas_to_aggregate), max(1, int(num_replicas))
     if not 0 <= R <= N:
         raise ValueError(
@@ -413,15 +430,21 @@ def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
                     replicas_to_aggregate: int = 0,
                     dequant: str | None = None,
                     dequant_impl: str = "auto",
-                    quantize: str = "auto") -> Callable:
+                    quantize: str = "auto",
+                    bucket_bytes: int | None = None,
+                    bucket_shard_update: bool = False) -> Callable:
     """Build the jitted (state, batch) -> (state, metrics) step.
 
     ``dequant``: spec for HOST-FED uint8 batches (``batcher.dequant``);
     the resident/indexed path dequantizes in its gather instead.
     ``dequant_impl``/``quantize``: the in-step dequant kernel knobs (same
-    resolution rule as the resident path — see ``dequant_host_batch``)."""
+    resolution rule as the resident path — see ``dequant_host_batch``).
+    ``bucket_bytes``/``bucket_shard_update``: the ``--bucket_grads``
+    collective schedule (see ``_build_step_fn``)."""
     inner = _build_step_fn(label_smoothing, ce_impl, mesh,
-                           num_replicas, replicas_to_aggregate)
+                           num_replicas, replicas_to_aggregate,
+                           bucket_bytes=bucket_bytes,
+                           bucket_shard_update=bucket_shard_update)
 
     def step(state: TrainState, batch):
         return inner(state, dequant_host_batch(batch, dequant, dequant_impl,
@@ -438,7 +461,9 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             replicas_to_aggregate: int = 0,
                             num_slots: int | None = None,
                             data_sharding: str = "replicated",
-                            dequant_impl: str = "auto") -> Callable:
+                            dequant_impl: str = "auto",
+                            bucket_bytes: int | None = None,
+                            bucket_shard_update: bool = False) -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -469,7 +494,9 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     """
     num_slots = _resolve_num_slots(unroll_steps, steps_per_epoch, num_slots)
     inner = _build_step_fn(label_smoothing, ce_impl, mesh, num_replicas,
-                           replicas_to_aggregate)
+                           replicas_to_aggregate,
+                           bucket_bytes=bucket_bytes,
+                           bucket_shard_update=bucket_shard_update)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
                                 num_slots=num_slots,
                                 data_sharding=data_sharding,

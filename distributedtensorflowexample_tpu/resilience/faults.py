@@ -44,6 +44,19 @@ kind                models
                     distinguishes a lost rank from a clean preemption;
                     the gang-supervision drill's "kill rank 1 at
                     step 37" (resilience/fleet.py)
+``slow_rank``       a PERSISTENT straggler: every step boundary from the
+                    fault step onward is delayed ``arg`` seconds
+                    (default 0.25) — slow-but-alive, heartbeats keep
+                    flowing, nothing crashes; only throughput suffers.
+                    Pinned to one rank (``slow_rank@10:0.5%1``) it is
+                    the reproducible scenario the lockstep-SPMD
+                    ``replicas_to_aggregate`` shape exists for, and the
+                    control case for the bucketed/overlapped collective
+                    schedules (--bucket_grads): a straggler stretches
+                    every rendezvous, so fewer collectives per step =
+                    fewer stretch points.  Survives resume: a plan step
+                    already passed at restart re-activates the delay
+                    (the rank is still slow) instead of dropping it
 ==================  =====================================================
 
 A plan is addressed by ``(text, num_steps, seed)``: unpinned fault steps
@@ -82,7 +95,8 @@ from distributedtensorflowexample_tpu.training.hooks import (
     Hook, _EveryN, touch_heartbeat)
 
 FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
-               "torn_snapshot", "heartbeat_flap", "journal_torn", "kill")
+               "torn_snapshot", "heartbeat_flap", "journal_torn", "kill",
+               "slow_rank")
 _BATCH_KINDS = ("nan_loss", "corrupt_batch")
 _POST_EXIT_KINDS = ("torn_snapshot", "journal_torn")
 
@@ -116,6 +130,9 @@ NAMED_PLANS = {
     # HAS a next attempt — the torn journal only matters at replay.
     "journal_torn": [("journal_torn", None, 0.0),
                      ("preemption", None, 0.0)],
+    # Mild persistent straggle from the anchor step on; pin a rank with
+    # the spec grammar (slow_rank@N:SECS%RANK) for gang drills.
+    "slow_rank": [("slow_rank", None, 0.25)],
 }
 
 
@@ -195,7 +212,8 @@ class FaultPlan:
             specs.append(FaultSpec(
                 kind, int(steptxt) if steptxt else anchor,
                 float(argtxt) if argtxt else
-                (2.0 if kind == "wedge" else 0.0),
+                (2.0 if kind == "wedge" else
+                 0.25 if kind == "slow_rank" else 0.0),
                 rank=int(ranktxt) if ranktxt else None))
         return cls(specs, seed=seed, name=text)
 
@@ -243,11 +261,21 @@ class FaultInjectionHook(Hook):
     def __init__(self, plan: FaultPlan):
         self._plan = plan
         self._fired: set[int] = set()
+        # slow_rank accumulator: once its spec fires, every later
+        # boundary sleeps this long (a straggler is a CONDITION, not an
+        # event — unlike wedge's one-shot block).
+        self._slow_s = 0.0
 
     def begin(self, loop) -> None:
+        self._slow_s = 0.0
         for i, s in enumerate(self._plan.loop_specs):
             if s.step <= loop.start_step:
                 self._fired.add(i)
+                if s.kind == "slow_rank":
+                    # A resumed run past the fault step is STILL slow —
+                    # the condition re-activates without re-counting as
+                    # a fresh injection.
+                    self._slow_s += s.arg
 
     def after_step(self, step, state, metrics) -> bool:
         for i, s in enumerate(self._plan.loop_specs):
@@ -255,7 +283,9 @@ class FaultInjectionHook(Hook):
                 continue
             self._fired.add(i)
             _mark_fired(s, step)
-            if s.kind == "wedge":
+            if s.kind == "slow_rank":
+                self._slow_s += s.arg
+            elif s.kind == "wedge":
                 # Blocks without raising — exactly what a dead tunnel
                 # does to a jit call.  The heartbeat goes stale; only an
                 # external watchdog (resilience.supervisor) can act.
@@ -311,6 +341,10 @@ class FaultInjectionHook(Hook):
                 # disk (the snapshot this boundary's SnapshotHook wrote
                 # before this hook fired) plus an external supervisor.
                 os.kill(os.getpid(), signal.SIGKILL)
+        if self._slow_s:
+            # The straggler condition: pure boundary delay, heartbeats
+            # and hooks untouched — slow-but-alive by construction.
+            time.sleep(self._slow_s)
         return False
 
 

@@ -114,6 +114,33 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
             f"sync_mode={current['sync_mode']!r} would mismatch the state "
             f"layout (worker-tiled vs replicated). Use a fresh --log_dir "
             f"or rerun with --sync_mode={saved['sync_mode']}")
+    # Pre-PR-6 checkpoints carry no update_layout key; the only layout
+    # they can hold is the params-shaped tree — default to that, never
+    # to the CURRENT run's layout (which would wave a legacy checkpoint
+    # into a bucket_rows run and die on an unnamed Orbax mismatch).
+    saved_layout = saved.get("update_layout", "tree")
+    if saved_layout != current.get("update_layout"):
+        raise ValueError(
+            f"checkpoint in {log_dir}/checkpoints holds "
+            f"{saved_layout!r} optimizer state; this run uses "
+            f"{current['update_layout']!r} (--bucket_grads with "
+            f"--shard_update stores per-bucket flat rows instead of the "
+            f"params-shaped tree). Resume with the writing run's knobs "
+            f"or start fresh with a new --log_dir")
+    if (saved_layout == "bucket_rows"
+            and saved.get("mesh_size") is not None
+            and saved["mesh_size"] != current["mesh_size"]):
+        # Bucket rows are a function of D ([D, ceil(n/D)] layout +
+        # padding): a different mesh size is at best an unnamed Orbax
+        # shape error and at worst — when the padded totals happen to
+        # match — a silently PERMUTED momentum restore.
+        raise ValueError(
+            f"checkpoint in {log_dir}/checkpoints holds bucket_rows "
+            f"optimizer state laid out for mesh_size="
+            f"{saved['mesh_size']}; this run has mesh_size="
+            f"{current['mesh_size']} — the 1/D row layout is structural. "
+            f"Resume on {saved['mesh_size']} devices or start fresh "
+            f"with a new --log_dir")
     if (saved.get("num_workers") is not None
             and saved["num_workers"] != current["num_workers"]):
         raise ValueError(
@@ -226,6 +253,20 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             "async mode's state is already worker-tiled (each device owns "
             "its workers' whole update) — there is no cross-replica "
             "redundancy to shard away")
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        resolve_bucket_bytes)
+    bucket_bytes = resolve_bucket_bytes(cfg.bucket_grads)  # fails by name
+    if bucket_bytes and cfg.fused_optimizer:
+        raise ValueError(
+            "--bucket_grads restructures the gradient reduction around "
+            "the optimizer apply; the Pallas fused apply is a custom "
+            "call with its own layout contract — use one or the other")
+    # The explicit per-bucket ZeRO-1 schedule replaces the GSPMD
+    # constraint form of --shard_update (see parallel/bucketing.py);
+    # on a 1-device mesh there is nothing to reduce and the plain step
+    # (with the constraint wrapper's 1-extent no-op) is used as-is.
+    bucket_zero1 = bool(bucket_bytes) and cfg.shard_update \
+        and num_replicas > 1 and cfg.sync_mode == "sync"
 
     train_x, train_y = _load_dataset(cfg, dataset_name, "train")
     test_x, test_y = _load_dataset(cfg, dataset_name, "test")
@@ -250,10 +291,29 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     model = build_model(model_name, dropout=cfg.dropout,
                         dtype=jnp.dtype(cfg.dtype), remat=cfg.remat)
-    tx = build_optimizer(cfg, mesh=mesh)
+    tx = build_optimizer(cfg, mesh=mesh,
+                         wrap_shard_update=not bucket_zero1)
     sample_shape = (global_batch,) + _SAMPLE_SHAPES[dataset_name]
     state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
-    if cfg.shard_update:
+    if bucket_bytes and cfg.sync_mode == "sync" and num_replicas > 1 \
+            and state.batch_stats:
+        raise ValueError(
+            f"--bucket_grads cannot run {model_name!r}: its BatchNorm "
+            f"computes global-batch statistics, which the bucketed "
+            f"per-shard gradient region would silently turn into "
+            f"per-shard statistics (a different model, not a different "
+            f"collective schedule). Use the default fused all-reduce "
+            f"for BatchNorm models")
+    if bucket_zero1:
+        # The bucketed ZeRO-1 step keeps optimizer state as per-bucket
+        # flat rows (1/D per device) — replace the params-shaped state
+        # create_sharded laid out with that working layout so donation
+        # aliases from call one (see parallel/bucketing.py).
+        from distributedtensorflowexample_tpu.parallel.bucketing import (
+            init_bucketed_opt_state)
+        state = state.replace(opt_state=init_bucketed_opt_state(
+            tx, state.params, bucket_bytes, mesh))
+    elif cfg.shard_update:
         # create_sharded lays the WHOLE state out replicated; re-lay the
         # optimizer state into its 1/D-per-device sharding now so the
         # step's first call already matches the in-step constraints
@@ -285,7 +345,12 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # num_workers, so worker count is structural; sync state is replicated
     # and restores fine across mesh sizes — recorded but not refused).
     run_meta = {"sync_mode": cfg.sync_mode, "mesh_size": num_replicas,
-                "num_workers": num_replicas if is_async else None}
+                "num_workers": num_replicas if is_async else None,
+                # bucket_rows: optimizer state stored as per-bucket flat
+                # 1/D rows (the bucketed ZeRO-1 schedule) — structurally
+                # different from the params-shaped tree layout, so a
+                # cross-layout resume must be refused by name.
+                "update_layout": "bucket_rows" if bucket_zero1 else "tree"}
     if cfg.checkpoint_every > 0 or cfg.resume:
         manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
                                     max_to_keep=cfg.keep_checkpoints,
@@ -374,14 +439,15 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             cfg.label_smoothing, ce_impl=ce_impl, mesh=mesh,
             unroll_steps=steps_per_call, augment=device_augment,
             num_slots=ds.num_slots, data_sharding=cfg.data_sharding,
-            dequant_impl=cfg.dequant_impl)
+            dequant_impl=cfg.dequant_impl, bucket_bytes=bucket_bytes)
     elif is_async:
         train_step = make_async_train_step(num_replicas, cfg.async_period,
                                            cfg.label_smoothing,
                                            ce_impl=ce_impl, mesh=mesh,
                                            dequant=batcher.dequant,
                                            dequant_impl=cfg.dequant_impl,
-                                           quantize=cfg.quantize)
+                                           quantize=cfg.quantize,
+                                           bucket_bytes=bucket_bytes)
     elif use_device_data:
         train_step = make_indexed_train_step(
             global_batch, ds.steps_per_epoch, cfg.label_smoothing,
@@ -389,14 +455,17 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             augment=device_augment, num_replicas=num_replicas,
             replicas_to_aggregate=cfg.replicas_to_aggregate,
             num_slots=ds.num_slots, data_sharding=cfg.data_sharding,
-            dequant_impl=cfg.dequant_impl)
+            dequant_impl=cfg.dequant_impl, bucket_bytes=bucket_bytes,
+            bucket_shard_update=bucket_zero1)
     else:
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
                                      mesh=mesh, num_replicas=num_replicas,
                                      replicas_to_aggregate=cfg.replicas_to_aggregate,
                                      dequant=batcher.dequant,
                                      dequant_impl=cfg.dequant_impl,
-                                     quantize=cfg.quantize)
+                                     quantize=cfg.quantize,
+                                     bucket_bytes=bucket_bytes,
+                                     bucket_shard_update=bucket_zero1)
     # Preemption safety (TPU-first failure recovery, SURVEY §5): the
     # platform sends SIGTERM before reclaiming a slice/VM.  The handler
     # only SETS A FLAG — the loop polls it at call boundaries and stops
@@ -463,13 +532,55 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # anything else via OBS_FLIGHT=1.
     from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
     from distributedtensorflowexample_tpu.training.hooks import MetricsHook
-    hooks.append(MetricsHook(every=cfg.log_every))
+    # Per-step collective accounting (OBS_COLLECTIVES=1): inventory the
+    # compiled step's collectives once and feed the registry counters
+    # per boundary.  Opt-in because the AOT lower().compile() does NOT
+    # share the jit executable cache on this jax pin — arming it costs
+    # one extra compile of the train step (device-resident path only:
+    # it has a peekable batch to lower against).
+    collectives = None
+    if os.environ.get("OBS_COLLECTIVES") == "1" and use_device_data:
+        from distributedtensorflowexample_tpu.utils.profiling import (
+            collective_inventory_of)
+        inv = collective_inventory_of(train_step, (state, ds.peek()),
+                                      unroll=steps_per_call)
+        if inv and inv.get("multiset"):
+            collectives = inv
+            note = ""
+            if is_async and cfg.async_period > 1:
+                # The worker-average psums are cond-gated on the period:
+                # the module-weight inventory counts them at every step,
+                # so SUSTAINED wire traffic is the totals divided by the
+                # period (bench_scaling's amortized_bytes_per_step
+                # approximation, documented there: the every-step
+                # scalar-metrics psum pair — 8 B — is amortized along
+                # with it).  The per-op gauges keep the raw compiled
+                # schedule; only the cumulative counters amortize.
+                collectives = dict(
+                    inv,
+                    total_count_per_step=(inv["total_count_per_step"]
+                                          / cfg.async_period),
+                    total_out_bytes_per_step=(
+                        inv["total_out_bytes_per_step"]
+                        / cfg.async_period))
+                note = (f", sustained /{cfg.async_period} (cond-gated "
+                        f"worker average): "
+                        f"{collectives['total_out_bytes_per_step']:.0f} B")
+            if is_chief:
+                print(f"collectives per step: {inv['multiset']} "
+                      f"({inv['total_out_bytes_per_step']} B out in the "
+                      f"compiled schedule{note})", flush=True)
+    hooks.append(MetricsHook(every=cfg.log_every, collectives=collectives))
     rec = obs_recorder.maybe_install()
     if rec is not None:
         # (rank, attempt, phase land in the flight payload itself —
         # the recorder reads OBS_RANK/SUPERVISE_ATTEMPT/OBS_PHASE.)
         rec.note(trainer=model_name, dataset=dataset_name,
                  sync_mode=cfg.sync_mode, log_dir=cfg.log_dir)
+        if collectives is not None:
+            rec.note(collectives_per_step=collectives["multiset"],
+                     collective_bytes_per_step=collectives[
+                         "total_out_bytes_per_step"])
 
     with sigterm_flag() as preempted:
         with mesh:

@@ -150,7 +150,7 @@ class MetricsHook(Hook):
     device never waits on telemetry between log boundaries.
     """
 
-    def __init__(self, every: int = 1):
+    def __init__(self, every: int = 1, collectives: dict | None = None):
         self._every = max(1, every)
         self._steps = obs_metrics.counter(
             "train_steps_total", "completed global training steps")
@@ -161,6 +161,32 @@ class MetricsHook(Hook):
         self._window_h = obs_metrics.histogram(
             "train_window_seconds",
             "wall seconds between loop call boundaries")
+        # Per-step collective accounting (utils/profiling.collective_
+        # inventory summary, when the trainer armed it): static per-op
+        # gauges set once, cumulative counters fed per boundary — two
+        # lock-free adds on the hot path, nothing when absent.
+        self._coll_ops = self._coll_bytes = None
+        if collectives and collectives.get("multiset"):
+            ops_g = obs_metrics.gauge(
+                "collective_ops_per_step",
+                "collectives per training step, from the compiled HLO")
+            bytes_g = obs_metrics.gauge(
+                "collective_bytes_per_step",
+                "collective output bytes per training step")
+            for op, d in collectives["per_step"].items():
+                ops_g.labels(op=op).set(d["count"])
+                bytes_g.labels(op=op).set(d["out_bytes"])
+            self._coll_ops = obs_metrics.counter(
+                "collective_ops_total",
+                "collective operations dispatched (per-step inventory x "
+                "completed steps)")
+            self._coll_bytes = obs_metrics.counter(
+                "collective_bytes_total",
+                "collective output bytes moved (per-step inventory x "
+                "completed steps)")
+            self._coll_ops_per_step = collectives["total_count_per_step"]
+            self._coll_bytes_per_step = collectives[
+                "total_out_bytes_per_step"]
         self._due = _EveryN(self._every)
         self._last_step = 0
         self._last_t = self._mark_t = time.perf_counter()
@@ -178,9 +204,13 @@ class MetricsHook(Hook):
 
     def after_step(self, step, state, metrics) -> bool:
         now = time.perf_counter()
-        self._steps.inc(step - self._last_step)
+        advanced = step - self._last_step
+        self._steps.inc(advanced)
         self._step_g.set(step)
         self._window_h.observe(now - self._last_t)
+        if self._coll_ops is not None:
+            self._coll_ops.inc(self._coll_ops_per_step * advanced)
+            self._coll_bytes.inc(self._coll_bytes_per_step * advanced)
         self._last_step = step
         self._last_t = now
         if self._due(step):

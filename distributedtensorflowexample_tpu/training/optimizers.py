@@ -121,8 +121,16 @@ def cross_replica_update_sharding(tx: optax.GradientTransformation,
     return optax.GradientTransformation(init, update)
 
 
-def build_optimizer(cfg: RunConfig,
-                    mesh=None) -> optax.GradientTransformation:
+def build_optimizer(cfg: RunConfig, mesh=None,
+                    wrap_shard_update: bool = True
+                    ) -> optax.GradientTransformation:
+    """``wrap_shard_update=False`` skips the GSPMD-constraint wrapper
+    even when ``cfg.shard_update`` is set: the bucketed step
+    (``--bucket_grads`` + ``--shard_update``) IMPLEMENTS the
+    reduce-scatter/sharded-update/all-gather schedule explicitly per
+    bucket (parallel/bucketing.py) and applies the base transformation
+    to flat row shards — constraint-wrapping it there would re-shard
+    already-sharded rows."""
     sched = build_schedule(cfg)
     if cfg.fused_optimizer:
         if cfg.momentum <= 0.0 or cfg.weight_decay > 0.0:
@@ -148,5 +156,6 @@ def build_optimizer(cfg: RunConfig,
     if cfg.shard_update:
         if mesh is None:
             raise ValueError("--shard_update requires a device mesh")
-        tx = cross_replica_update_sharding(tx, mesh)
+        if wrap_shard_update:
+            tx = cross_replica_update_sharding(tx, mesh)
     return tx

@@ -232,26 +232,15 @@ def _operand_token(line: str, start: int) -> str:
     return inner
 
 
-def hlo_bytes_by_op(hlo_text: str, unroll: int = 1) -> list:
-    """Per-instruction bytes rows from optimized HLO text.
-
-    Control flow is walked from ENTRY: ``call``/``conditional`` targets
-    inherit the caller's weight, ``while`` bodies are weighted ``unroll``
-    times (the ONE while in our programs is the ``lax.scan`` over fused
-    train steps, whose trip count IS the unroll).  Fusion ``calls=`` and
-    reduce ``to_apply=`` computations stay excluded — their internals
-    don't touch memory separately.
-
-    Returns rows sorted by bytes descending; each row is a dict with
-    ``bytes`` (weighted, whole module), ``effective_bytes`` (gather
-    operands re-priced at rows-touched — see module comment), ``category``,
-    ``opcode``, ``name``, ``out`` (output shape token) and ``op_name``
-    (source metadata — the flax module path for model ops).
-    """
-    comps, entry = _split_computations(hlo_text)
-    if entry is None:
-        return []
-
+def _computation_weights(comps: dict, entry: str, unroll: int) -> dict:
+    """Execution weight per computation, walked from ENTRY:
+    ``call``/``conditional`` targets inherit the caller's weight,
+    ``while`` bodies are weighted ``unroll`` times (the ONE while in our
+    programs is the ``lax.scan`` over fused train steps, whose trip count
+    IS the unroll).  Fusion ``calls=`` and reduce ``to_apply=``
+    computations stay excluded — their internals don't touch memory (or
+    the wire) separately.  Shared by the bytes audit and the collective
+    inventory so both instruments normalize per-step identically."""
     weights: dict[str, int] = defaultdict(int)
 
     def visit(name: str, weight: int) -> None:
@@ -271,6 +260,24 @@ def hlo_bytes_by_op(hlo_text: str, unroll: int = 1) -> list:
                             visit(target, weight)
 
     visit(entry, 1)
+    return weights
+
+
+def hlo_bytes_by_op(hlo_text: str, unroll: int = 1) -> list:
+    """Per-instruction bytes rows from optimized HLO text.
+
+    Control flow is walked from ENTRY (see :func:`_computation_weights`).
+
+    Returns rows sorted by bytes descending; each row is a dict with
+    ``bytes`` (weighted, whole module), ``effective_bytes`` (gather
+    operands re-priced at rows-touched — see module comment), ``category``,
+    ``opcode``, ``name``, ``out`` (output shape token) and ``op_name``
+    (source metadata — the flax module path for model ops).
+    """
+    comps, entry = _split_computations(hlo_text)
+    if entry is None:
+        return []
+    weights = _computation_weights(comps, entry, unroll)
 
     rows = []
     for comp, weight in weights.items():
@@ -344,6 +351,131 @@ def bytes_audit(hlo_text: str, unroll: int = 1, top_k: int = 12) -> dict:
             sorted(by_cat_eff.items(), key=lambda kv: -kv[1])},
         "top_ops": top,
     }
+
+
+# ---------------------------------------------------------------------------
+# Per-collective accounting (the comms twin of the bytes audit).
+#
+# The bytes audit says WHICH ops carry the HBM traffic; nothing said which
+# collectives carry the wire traffic — the sync trainer's gradient
+# all-reduce and the --shard_update reduce-scatter/all-gather schedule were
+# invisible (test_device_data.py could only assert the collective SET).
+# The optimized HLO names every collective with its shapes and replica
+# groups inline, so the same parse that prices bytes can inventory the
+# wire: per-instruction rows, a per-step multiset, and totals that tie out
+# EXACTLY against the bytes audit's "collective" category (same text, same
+# weights, same out+operands convention).
+
+_COLLECTIVE_OPCODES = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute"})
+# Literal forms: nested brace lists ({{0,1},{2,3}}), the empty {}, and
+# the iota form ([1,8]<=[8]).
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{.*?\}\}|\{\}|\[[^\]]*\](?:<=\[[^\]]*\])?)")
+
+
+def collective_inventory(hlo_text: str, unroll: int = 1) -> dict:
+    """Per-collective accounting from optimized HLO text.
+
+    Each collective instruction becomes a row: ``opcode`` (async
+    ``-start`` forms normalized to the base op; ``-done`` halves skipped
+    — one wire transfer, not two), ``count`` (execution weight, whole
+    module — scan bodies weighted by trip count), ``out_bytes`` /
+    ``operand_bytes`` per execution, ``accounting_bytes`` (out +
+    operands, the HloCostAnalysis convention the bytes audit uses — the
+    number that ties out against ``bytes_audit``'s "collective"
+    category), and ``replica_groups`` (the partition literal: which
+    devices reduce together).
+
+    The summary normalizes by ``unroll`` so records from
+    differently-fused programs compare directly:
+
+    * ``per_step``: {opcode: {count, out_bytes, accounting_bytes}}
+    * ``multiset``: {opcode: count} — the golden per-trainer inventory
+      (the ``test_device_data`` collective-set assertion, generalized
+      into a measurement)
+    * ``total_*_per_step`` rollups.
+
+    ``out_bytes`` is the per-op OUTPUT size (the convention
+    ``bench_scaling.collective_traffic`` reports); for a same-size
+    all-reduce output==operand, for all-gather output is the gathered
+    size, for reduce-scatter the scattered shard.  Collectives inside a
+    ``conditional`` (e.g. the async worker average, gated on the period)
+    are counted at the caller's weight — sustained traffic for
+    period-gated ops is count/period, which the caller divides."""
+    comps, entry = _split_computations(hlo_text)
+    empty = {"ops": [], "per_step": {}, "multiset": {},
+             "total_count_per_step": 0, "total_out_bytes_per_step": 0,
+             "total_accounting_bytes_per_step": 0, "unroll": max(1, unroll)}
+    if entry is None:
+        return empty
+    weights = _computation_weights(comps, entry, unroll)
+
+    rows = []
+    for comp, weight in weights.items():
+        for name, out_tok, opcode, line, args_at in comps.get(comp, ()):
+            base = opcode[:-6] if opcode.endswith("-start") else opcode
+            if base not in _COLLECTIVE_OPCODES or opcode.endswith("-done"):
+                continue
+            operands = _operand_token(line, args_at)
+            out_b = _shape_bytes(out_tok)
+            op_b = sum(_shape_bytes(s.group(0))
+                       for s in _SHAPE_RE.finditer(operands))
+            mg = _REPLICA_GROUPS_RE.search(line)
+            rows.append({"opcode": base, "name": name, "count": weight,
+                         "out_bytes": out_b, "operand_bytes": op_b,
+                         "accounting_bytes": out_b + op_b,
+                         "replica_groups": mg.group(1) if mg else "",
+                         "out": out_tok.strip()[:60]})
+    rows.sort(key=lambda r: -r["out_bytes"] * r["count"])
+
+    u = max(1, unroll)
+
+    def norm(x):
+        # per-step weights are whole numbers for everything our programs
+        # emit; keep exactness when they are, floats when they are not
+        q = x / u
+        return int(q) if q == int(q) else round(q, 6)
+
+    per_step: dict[str, dict] = {}
+    for r in rows:
+        d = per_step.setdefault(r["opcode"],
+                                {"count": 0, "out_bytes": 0,
+                                 "accounting_bytes": 0})
+        d["count"] += r["count"]
+        d["out_bytes"] += r["out_bytes"] * r["count"]
+        d["accounting_bytes"] += r["accounting_bytes"] * r["count"]
+    for d in per_step.values():
+        for k in d:
+            d[k] = norm(d[k])
+    return {
+        "ops": rows,
+        "per_step": dict(sorted(per_step.items(),
+                                key=lambda kv: -kv[1]["out_bytes"])),
+        "multiset": {op: d["count"] for op, d in sorted(per_step.items())},
+        "total_count_per_step": norm(sum(r["count"] for r in rows)),
+        "total_out_bytes_per_step": norm(
+            sum(r["out_bytes"] * r["count"] for r in rows)),
+        "total_accounting_bytes_per_step": norm(
+            sum(r["accounting_bytes"] * r["count"] for r in rows)),
+        "unroll": u,
+    }
+
+
+def collective_inventory_of(step, args, unroll: int = 1) -> dict:
+    """Lower+compile a jitted *step* once and inventory its collectives.
+    Degrades to ``{}`` when the backend can't lower/expose the module
+    (same contract as :func:`cost_and_bytes_audit`).  NOTE: an AOT
+    ``lower().compile()`` does NOT populate the jit's own executable
+    cache on this jax pin, so calling this costs one extra compile of
+    the program — callers gate it (OBS_COLLECTIVES=1, bench phases)
+    rather than paying it on every run."""
+    try:
+        compiled = step.lower(*args).compile()
+        return collective_inventory(compiled.as_text(), unroll=unroll)
+    except Exception:
+        return {}
 
 
 def cost_and_bytes_audit(step, args, unroll: int = 1, top_k: int = 12,

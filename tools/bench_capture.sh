@@ -42,6 +42,7 @@ OUT=${OUT:-BENCH_auto_r05.json}
 OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r05.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_auto_r05.json}
 BYTES_OUT=${BYTES_OUT:-BYTES_AUDIT_r05.json}
+COLLECTIVES_OUT=${COLLECTIVES_OUT:-BENCH_collectives_r06.json}
 TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r05.tgz}
 CLI_OUT=${CLI_OUT:-CLI_r05.log}
 TRACE_DIR=${TRACE_DIR:-/tmp/resnet_trace}
@@ -141,6 +142,17 @@ fi
 # audit JSON still lands.
 run_bytes_audit
 bail_if_wedged "$rc2" "full bench skipped: profile watchdog fired (backend wedged)"
+
+# --- phase 2c: collective latency/bandwidth curves + knee -----------------
+# bench_collectives.py --real: probes with the bench env knobs and emits
+# a sentinel record when the backend is down (never hangs the window);
+# under an exported JAX_PLATFORMS=cpu the record self-labels
+# platform=cpu so CPU curves are never mistaken for chip numbers.
+python bench_collectives.py --real --json "$COLLECTIVES_OUT.tmp" \
+  >> "$LOG" 2>> "$LOG"
+rc2c=$?
+keep "$COLLECTIVES_OUT.tmp" "$COLLECTIVES_OUT"
+echo "collectives rc=$rc2c" >> "$LOG"
 
 # --- phase 3: full bench --------------------------------------------------
 python bench.py > "$OUT.tmp" 2>> "$LOG"

@@ -11,9 +11,11 @@ command (and one tier-1-safe smoke test):
   # resumes from the snapshot, finishes, exit 0
 
 Plans (resilience/faults.py NAMED_PLANS): preempt, wedge, nan_loss,
-corrupt_batch, torn_snapshot, heartbeat_flap, journal_torn, none — or
-explicit specs like
-``preemption@3`` / ``wedge@2:5.0``, comma-separated.  The same
+corrupt_batch, torn_snapshot, heartbeat_flap, journal_torn, slow_rank,
+none — or explicit specs like
+``preemption@3`` / ``wedge@2:5.0`` / ``slow_rank@5:0.5%1`` (rank 1
+turns persistent straggler at step 5: every later boundary delayed
+0.5 s, heartbeats alive, survives resume), comma-separated.  The same
 ``(--plan, --steps, --seed)`` triple reproduces the same scenario
 anywhere.  Under the supervisor, faults are TRANSIENT by default: they
 fire on attempt 0 only (SUPERVISE_ATTEMPT), like the real corrupted

@@ -24,6 +24,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 
 
@@ -38,6 +39,37 @@ def _fmt_num(v) -> str:
     if isinstance(v, float):
         return f"{v:.6g}"
     return str(v)
+
+
+_COLL_SERIES = re.compile(
+    r'^collective_(ops|bytes)_per_step\{op="([^"]+)"\}$')
+
+
+def render_collectives(counters: dict, gauges: dict) -> list[str]:
+    """Wire-traffic section from the per-step collective inventory the
+    trainer armed (OBS_COLLECTIVES=1 — utils/profiling.collective_
+    inventory through MetricsHook): the per-op schedule plus cumulative
+    totals, so a postmortem answers "what was this run's collective
+    schedule" without recompiling anything.  Empty when the run carried
+    no collective accounting."""
+    per_op: dict[str, dict] = {}
+    for key, g in gauges.items():
+        m = _COLL_SERIES.match(key)
+        if m:
+            per_op.setdefault(m.group(2), {})[m.group(1)] = g.get("value")
+    out: list[str] = []
+    if per_op:
+        out += _table(["op", "per step", "bytes/step"],
+                      [[f"`{op}`", _fmt_num(d.get("ops", "")),
+                        _fmt_num(d.get("bytes", ""))]
+                       for op, d in sorted(per_op.items())])
+    totals = [(k, counters[k]) for k in
+              ("collective_ops_total", "collective_bytes_total")
+              if k in counters]
+    if totals:
+        out += [""] if out else []
+        out += [f"- **{k}**: {_fmt_num(v)}" for k, v in totals]
+    return out
 
 
 def render_flight(path: str, flight: dict, max_spans: int = 12,
@@ -70,6 +102,10 @@ def render_flight(path: str, flight: dict, max_spans: int = 12,
                    else f"{ts - g['monotonic_ts']:.3f}")
             rows.append([f"`{k}`", _fmt_num(g.get("value")), age])
         lines += _table(["gauge", "value", "age_s"], rows)
+
+    coll = render_collectives(counters, gauges)
+    if coll:
+        lines += ["", "### Collectives", ""] + coll
 
     spans = flight.get("spans") or []
     if spans:

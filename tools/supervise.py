@@ -86,6 +86,7 @@ def _capture_tasks(start_ts: float,
     out_headline = env.get("OUT_HEADLINE", "BENCH_headline_r05.json")
     profile_out = env.get("PROFILE_OUT", "PROFILE_auto_r05.json")
     bytes_out = env.get("BYTES_OUT", "BYTES_AUDIT_r05.json")
+    collectives_out = env.get("COLLECTIVES_OUT", "BENCH_collectives_r06.json")
     trace_tgz = env.get("TRACE_TGZ", "resnet_trace_r05.tgz")
     cli_out = env.get("CLI_OUT", "CLI_r05.log")
     trace_dir = env.get("TRACE_DIR", "/tmp/resnet_trace")
@@ -107,13 +108,21 @@ def _capture_tasks(start_ts: float,
                             "-C", os.path.dirname(trace_dir),
                             os.path.basename(trace_dir)], check=False)
 
-    def keep_bytes_json() -> None:
-        tmp = bytes_out + ".tmp"
-        if os.path.exists(tmp):
-            if os.path.getsize(tmp):
-                os.replace(tmp, bytes_out)
-            else:
-                os.remove(tmp)
+    def keep_json(tmp: str, final: str):
+        """keep() semantics for --json artifacts: promote a non-empty
+        tmp, drop an empty one (a killed attempt never clobbers a
+        previous window's artifact)."""
+        def _keep() -> None:
+            if os.path.exists(tmp):
+                if os.path.getsize(tmp):
+                    os.replace(tmp, final)
+                else:
+                    os.remove(tmp)
+        return _keep
+
+    keep_bytes_json = keep_json(bytes_out + ".tmp", bytes_out)
+    keep_collectives_json = keep_json(collectives_out + ".tmp",
+                                      collectives_out)
 
     def fresh_measured() -> bool:
         """Phase-4 gate from bench_capture.sh: the trainer has no
@@ -158,6 +167,17 @@ def _capture_tasks(start_ts: float,
               "--json", bytes_out + ".tmp"],
              priority=25, needs_chip=False, stderr_path=log,
              post=keep_bytes_json),
+        # phase 2c: collective latency/bandwidth curves + knee re-fit on
+        # the live backend (bench_collectives.py --real).  Probes with
+        # bench.py's env knobs and emits a sentinel record when the
+        # backend is down, so the queue keeps moving; with the shell
+        # profile's JAX_PLATFORMS=cpu export still in force the record
+        # self-labels platform=cpu (never mistakable for chip curves).
+        Task("collectives",
+             [py, "bench_collectives.py", "--real",
+              "--json", collectives_out + ".tmp"],
+             priority=27, stderr_path=log,
+             env=bench_env, post=keep_collectives_json),
         # phase 3: the full six-workload record.
         Task("full_bench", [py, "bench.py"], priority=30, stdout_path=out,
              stderr_path=log, env=bench_env),
