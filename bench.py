@@ -296,6 +296,15 @@ def _println(line: str) -> None:
 
 def _emit(metric: str, per_chip: float, baselines: dict, detail: dict) -> None:
     baseline = baselines.get(metric)
+    if detail.get("repeats") and "spread_frac" not in detail:
+        # Measurement-instability sentinel (obs/anomaly.spread_fraction,
+        # stdlib-only): (max-min)/max over the repeats.  A wide spread
+        # marks the window as noisy IN the record, so the ratchet
+        # (tools/bench_ratchet.py) can refuse to call a regression
+        # "unexplained" off a measurement that disagrees with itself.
+        from distributedtensorflowexample_tpu.obs.anomaly import (
+            spread_fraction)
+        detail["spread_frac"] = round(spread_fraction(detail["repeats"]), 4)
     _println(json.dumps({
         "metric": metric,
         "value": round(per_chip, 2),

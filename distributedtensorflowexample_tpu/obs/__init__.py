@@ -22,6 +22,16 @@ Four cooperating pieces, each usable alone:
   escalation, so every dead run leaves a postmortem.
 - :mod:`.export` — Prometheus-textfile and JSONL exporters;
   ``tools/obs_report.py`` renders any dump as an OUTAGE_r*-style table.
+- :mod:`.timeline` — cross-rank merge of flights/trace JSONL/journals
+  into one wall-clock-aligned timeline (spans carry monotonic AND wall
+  stamps since round 10), with a Perfetto/Chrome-trace exporter and a
+  per-step anatomy decomposition (input/compute/snapshot/hook/other +
+  the compiled collective schedule).
+- :mod:`.anomaly` — online detectors fed from the same hooks: warmup-
+  pinned EWMA step-time regression, cross-rank skew/straggler
+  detection, NaN / loss-plateau sentinels; surfaced as registry
+  counters, a machine-readable ``health.json``, and flight-recorder
+  triggers (a detected anomaly dumps a postmortem BEFORE escalation).
 
 Deliberately **stdlib-only**: importing obs never pulls jax, so
 bench.py's record-survival contract (its SIGTERM handler must be live
@@ -29,6 +39,9 @@ before the first heavyweight import) and the supervisor's lightweight
 process both instrument themselves for free.
 """
 
+from distributedtensorflowexample_tpu.obs.anomaly import (  # noqa: F401
+    EwmaRegression, PlateauSentinel, RunHealth, detect_skew, read_health,
+    write_health)
 from distributedtensorflowexample_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry, counter, gauge, histogram, registry)
 from distributedtensorflowexample_tpu.obs.recorder import (  # noqa: F401

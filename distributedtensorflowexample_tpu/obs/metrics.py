@@ -31,9 +31,15 @@ import math
 import threading
 import time
 
-# Patchable seam: tests monkeypatch this to pin timestamps so flight
-# dumps are bitwise-reproducible.
+# Patchable seams: tests monkeypatch these to pin timestamps so flight
+# dumps are bitwise-reproducible.  ``_now`` is the monotonic clock every
+# in-process duration/age uses; ``_wall`` is the unix clock that lets
+# events from DIFFERENT processes line up on one timeline (monotonic
+# epochs are per-boot/per-namespace, wall clocks are shared on a host
+# and NTP-close across one) — the cross-rank merge in obs/timeline.py
+# aligns on wall stamps and keeps durations monotonic.
 _now = time.monotonic
+_wall = time.time
 
 # Span histogram defaults: wall seconds from sub-ms dispatch boundaries
 # to multi-minute capture phases.

@@ -2,12 +2,19 @@
 
 One event per closed span::
 
-    {"name": "snapshot", "t0_s": 12.345678, "dur_s": 0.004321,
-     "depth": 1, "parent": "steps", "step": 40,
+    {"name": "snapshot", "t0_s": 12.345678, "t0_unix": 1753900000.123456,
+     "dur_s": 0.004321, "depth": 1, "parent": "steps", "step": 40,
      "attempt": 1, "phase": "full_bench"}
 
 - ``t0_s``/``dur_s`` are monotonic-clock seconds (same clock as the
-  metrics registry, so spans and metric snapshots line up).
+  metrics registry, so spans and metric snapshots line up);
+  ``t0_unix`` is the SAME instant on the wall clock.  Both are
+  deliberate: monotonic is the honest duration/ordering axis inside one
+  process, but its epoch is per-boot — two ranks' monotonic stamps are
+  incomparable, which made cross-process alignment impossible before
+  round 10.  The wall stamp is what obs/timeline.py merges N ranks'
+  events on (derived once at close from the shared ``_wall`` seam, so a
+  pinned-clock test still gets bitwise-stable dumps).
 - ``attempt``/``phase`` are propagated from the environment the
   supervisor exports (``SUPERVISE_ATTEMPT``; ``OBS_PHASE`` is set per
   capture-queue task), read at span close — a child never has to thread
@@ -88,9 +95,16 @@ def event(name: str, dur_s: float, t0_s: float | None = None,
     measure a boundary-to-boundary window synthesize events this way).
     Returns the event dict (tests and callers may inspect it)."""
     stack = _stack()
+    now = _metrics._now()
+    if t0_s is None:
+        t0_s = now - dur_s
     rec = {"name": name,
-           "t0_s": round(_metrics._now() - dur_s if t0_s is None else t0_s,
-                         6),
+           "t0_s": round(t0_s, 6),
+           # The same open instant on the wall clock: wall-now minus the
+           # monotonic elapsed-since-open.  Computed at CLOSE (not open)
+           # so the synthesized-event path (hooks that only know a
+           # duration) gets the identical stamp semantics for free.
+           "t0_unix": round(_metrics._wall() - (now - t0_s), 6),
            "dur_s": round(dur_s, 6),
            "depth": len(stack),
            "parent": stack[-1] if stack else None,

@@ -63,6 +63,15 @@ Rank-loss taxonomy — a host that cannot be respawned degrades LOUDLY:
   lost rank, rebuilds TF_CONFIG from the survivors, and restarts the
   gang through the normal budgeted path.
 
+Online health (round 10, obs/anomaly.py): each rank's AnomalyHook
+writes a per-rank ``health.json`` (this fleet exports ``OBS_HEALTH``
+per child); the monitor loop reads them on a ~0.5 s cadence, runs the
+cross-rank skew/straggler pass (:func:`obs.anomaly.detect_skew`), and
+surfaces detections as gauges (``fleet_rank_step``,
+``fleet_step_skew_steps``), journal ``anomaly`` annotations, an
+aggregate fleet ``health.json``, and a flight dump on a new straggler.
+DETECTION ONLY — nothing it finds feeds the restart state machine.
+
 Everything here is CPU-testable with real OS processes — the same
 two-process pattern tests/test_multihost.py uses, no TPU required.
 """
@@ -79,6 +88,7 @@ import sys
 import time
 
 from distributedtensorflowexample_tpu.cluster import tf_config_env
+from distributedtensorflowexample_tpu.obs import anomaly as obs_anomaly
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
@@ -109,6 +119,14 @@ _KILLS = obs_metrics.counter(
 _HB_AGE = obs_metrics.gauge(
     "fleet_rank_heartbeat_age_seconds",
     "age of each live rank's newest heartbeat at the last poll")
+_RANK_STEP = obs_metrics.gauge(
+    "fleet_rank_step", "each rank's last health-reported step")
+_SKEW = obs_metrics.gauge(
+    "fleet_step_skew_steps",
+    "max step lag between the front rank and the rest (health reports)")
+_STRAGGLERS = obs_metrics.counter(
+    "fleet_stragglers_detected_total",
+    "straggler detections (lagging rank with slowness evidence), by rank")
 
 
 class RankLostError(RuntimeError):
@@ -199,7 +217,10 @@ class FleetSupervisor:
                  seed: int | None = None,
                  elastic: bool = False,
                  worker_tiled: bool = False,
-                 workdir: str = "/tmp/fleet"):
+                 workdir: str = "/tmp/fleet",
+                 health_path: str | None = None,
+                 skew_lag_steps: int = 3,
+                 skew_time_ratio: float = 4.0):
         if num_ranks < 1:
             raise ValueError(f"num_ranks {num_ranks} must be >= 1")
         self.num_ranks = num_ranks
@@ -215,6 +236,18 @@ class FleetSupervisor:
         self.worker_tiled = worker_tiled
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
+        # Fleet-level health.json (obs/anomaly.py contract): None means
+        # the workdir default; "" disables the aggregate write (the
+        # per-rank reads still feed gauges + journal annotations).
+        self.health_path = (os.path.join(self.workdir, "health.json")
+                            if health_path is None else health_path)
+        self.skew_lag_steps = skew_lag_steps
+        self.skew_time_ratio = skew_time_ratio
+        # Skew needs step DELTAS between polls, not just positions:
+        # reading per-rank health more often than it changes is wasted
+        # IO, and the detection-latency bound the drill asserts (<= 3
+        # steps of a 0.25 s/step straggler) only needs ~0.5 s cadence.
+        self._health_poll_s = max(poll_s, 0.5)
         self._rng = random.Random(seed)
         # One port per ORIGINAL rank, chosen once: a gang restart reuses
         # the same coordinator address, like a real re-scheduled job
@@ -233,6 +266,9 @@ class FleetSupervisor:
 
     def _hb_path(self, rank: int) -> str:
         return os.path.join(self.workdir, f"hb_rank{rank}")
+
+    def _health_path(self, rank: int) -> str:
+        return os.path.join(self.workdir, f"health_rank{rank}.json")
 
     def _spawn_rank(self, rank: int, index: int, hosts: list[str],
                     argv: list[str], name: str, attempt: int,
@@ -265,6 +301,18 @@ class FleetSupervisor:
         except OSError:
             pass
         env["SUPERVISE_HEARTBEAT"] = hb
+        # Per-rank health.json (training/hooks.AnomalyHook writes it,
+        # this fleet's monitor reads it) — always per-rank, never an
+        # inherited OBS_HEALTH: N ranks sharing one operator-exported
+        # path would overwrite each other's reports.  Stale-file reset
+        # for the same reason as the beat: a previous attempt's report
+        # would read as an instant regression/skew.
+        hp = self._health_path(rank)
+        try:
+            os.remove(hp)
+        except OSError:
+            pass
+        env["OBS_HEALTH"] = hp
         if self.heartbeat_timeout_s:
             env["SUPERVISE_HEARTBEAT_TIMEOUT_S"] = str(
                 self.heartbeat_timeout_s)
@@ -332,6 +380,130 @@ class FleetSupervisor:
         # dump its own flight); non-terminal so atexit still refreshes.
         obs_recorder.dump_global(f"gang_teardown_{why}", final=False)
 
+    # --- online anomaly monitoring (detection ONLY) -----------------------
+    def _stale_beat_span(self, rank: int, now: float) -> float | None:
+        """A live rank's no-beat span, reported ONLY when it is stale
+        relative to that rank's OWN observed beat cadence (the longest
+        mtime-to-mtime gap this monitor has seen, fleet-clocked).  Raw
+        heartbeat age is NOT slowness evidence: production trainers beat
+        every ~64 steps (trainers/common.py), so a healthy rank's age at
+        a random poll is uniform in [0, 64 x step] — far over any
+        step-time multiple.  A span > skew_time_ratio x the rank's own
+        cadence, while the beat file sits unchanged, is a genuine stall
+        (the wedged-but-alive shape).  Needs one observed beat interval
+        to calibrate; until then returns None — no evidence, never a
+        guess."""
+        try:
+            mtime = os.path.getmtime(self._hb_path(rank))
+        except OSError:
+            return None
+        prev = self._beat_obs.get(rank)
+        if prev is None or mtime != prev[0]:
+            interval = prev[2] if prev else None
+            if prev is not None:
+                seen = now - prev[1]
+                interval = max(interval or 0.0, seen)
+            self._beat_obs[rank] = (mtime, now, interval)
+            return None
+        frozen = now - prev[1]
+        if prev[2] and frozen > self.skew_time_ratio * prev[2]:
+            return round(frozen, 3)
+        return None
+
+    def _poll_health(self, name: str, attempt: int, ranks_all: list,
+                     exited=()) -> None:
+        """Read every live rank's health.json (obs/anomaly.py, written
+        by training/hooks.AnomalyHook under the OBS_HEALTH this fleet
+        exported), run the cross-rank skew/straggler pass, and surface
+        what it finds — gauges, journal ``anomaly`` annotations, an
+        aggregate fleet health.json, and a flight dump on a NEW
+        straggler.  Detection only, by design: nothing here feeds the
+        restart state machine — a false positive must cost a log line,
+        never a teardown."""
+        now = time.monotonic()
+        if now - self._health_polled_t < self._health_poll_s:
+            return
+        self._health_polled_t = now
+        ranks: dict = {}
+        payloads: dict = {}
+        # ALL ranks of the attempt, not just the live ones: these
+        # drills' children don't rendezvous, so a fast rank can finish
+        # while the straggler crawls on — its final health report is
+        # exactly the "front of the fleet" the skew pass measures
+        # against (and a finished rank can never be flagged itself:
+        # lagging requires trailing the front).
+        for r in ranks_all:
+            payload = obs_anomaly.read_health(self._health_path(r))
+            if payload is None:
+                continue
+            payloads[r] = payload
+            det = (payload.get("detectors") or {}).get("step_time") or {}
+            flags = payload.get("flags") or {}
+            if r in exited:
+                # A finished rank's beat stops BECAUSE it exited —
+                # staleness is not slowness evidence, and a cleanly
+                # preempted rank must not be named straggler while the
+                # others drain.  Its frozen report still serves as the
+                # front/lag datum above.
+                hb_age = None
+            else:
+                hb_age = self._stale_beat_span(r, now)
+            ranks[r] = {
+                "step": payload.get("step"),
+                "step_time_s": det.get("ewma_s"),
+                "regression_firing": (flags.get("step_time_regression")
+                                      or {}).get("firing"),
+                "hb_age_s": hb_age}
+            if payload.get("step") is not None:
+                _RANK_STEP.labels(rank=r).set(payload["step"])
+            # Per-rank detector firings annotate the journal ONCE per
+            # (rank, kind) per gang attempt — the postmortem's "rank 1
+            # saw nan_loss at step 7" line, next to the lifecycle
+            # events it explains.
+            for kind, f in flags.items():
+                # Latched fired_step, not the live firing flag: a
+                # transient firing (z decays in ~0.2 s) between 0.5 s
+                # polls must still annotate the journal — the same
+                # fired-or-firing read obs_report renders.
+                if (f.get("firing") or f.get("fired_step") is not None) \
+                        and (r, kind) not in self._flagged:
+                    self._flagged.add((r, kind))
+                    obs_anomaly.FLAGS_TOTAL.labels(kind=kind,
+                                                   rank=r).inc()
+                    self.journal.write(
+                        "anomaly", task=name, attempt=attempt, rank=r,
+                        kind=kind, fired_step=f.get("fired_step"))
+        skew = obs_anomaly.detect_skew(ranks,
+                                       lag_steps=self.skew_lag_steps,
+                                       time_ratio=self.skew_time_ratio)
+        if skew["lag_steps"]:
+            _SKEW.set(max(skew["lag_steps"].values()))
+        new = [r for r in skew["stragglers"] if r not in self._stragglers]
+        for r in new:
+            self._stragglers.add(r)
+            _STRAGGLERS.labels(rank=r).inc()
+            obs_anomaly.FLAGS_TOTAL.labels(kind="straggler", rank=r).inc()
+            self.journal.write(
+                "anomaly", task=name, attempt=attempt, rank=r,
+                kind="straggler", step=ranks[r].get("step"),
+                max_step=skew["max_step"], why=skew["why"].get(r))
+            _log(f"{name}: rank {r} straggling — {skew['why'].get(r)}")
+        if self.health_path and payloads:
+            obs_anomaly.write_health(self.health_path, {
+                "version": obs_anomaly.HEALTH_VERSION, "kind": "fleet",
+                "updated_unix": round(obs_metrics._wall(), 3),
+                "attempt": attempt,
+                "ranks": {str(r): p for r, p in sorted(payloads.items())},
+                "skew": skew,
+                "stragglers": sorted(self._stragglers),
+                "flags_seen": sorted(f"rank{r}:{k}"
+                                     for r, k in self._flagged)})
+        if new:
+            # The ring should cover the steps AROUND the detection, not
+            # whatever the gang later dies on; non-terminal, like every
+            # informed-survivor dump.
+            obs_recorder.dump_global("straggler_detected", final=False)
+
     # --- one gang attempt -------------------------------------------------
     def _run_gang(self, argv: list[str], name: str, attempt: int,
                   agreed: int | None, stdout_dir: str | None,
@@ -342,6 +514,22 @@ class FleetSupervisor:
         procs: dict[int, subprocess.Popen] = {}
         exited: dict[int, int | None] = {}
         sigterm_seen: list = []
+        # Anomaly latches are per gang attempt: a restart is a new run
+        # (fresh detectors in every child), so a prior attempt's
+        # straggler must not suppress this attempt's journal line.
+        self._stragglers: set = set()
+        self._flagged: set = set()
+        self._health_polled_t = -float("inf")
+        self._beat_obs: dict = {}       # rank -> (mtime, seen_at, interval)
+        # Stale-file reset, same reason as the per-rank files at spawn:
+        # a previous run's aggregate in a reused workdir would render as
+        # THIS run's stragglers (the monitor only rewrites it once some
+        # rank reports health).
+        if self.health_path:
+            try:
+                os.remove(self.health_path)
+            except OSError:
+                pass
 
         def _on_term(signum, frame):
             sigterm_seen.append(True)
@@ -472,6 +660,8 @@ class FleetSupervisor:
                                     f"{hb_age:.1f}s > "
                                     f"{self.heartbeat_timeout_s:.0f}s",
                                     exited)
+                self._poll_health(name, attempt, list(procs),
+                                  exited=exited)
                 time.sleep(self.poll_s)
 
     # --- resume-step agreement --------------------------------------------

@@ -571,6 +571,16 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                       f"({inv['total_out_bytes_per_step']} B out in the "
                       f"compiled schedule{note})", flush=True)
     hooks.append(MetricsHook(every=cfg.log_every, collectives=collectives))
+    # Online anomaly detection (obs/anomaly.py): always-on — the
+    # per-boundary cost is a few float ops, guarded with MetricsHook's
+    # budget — AFTER MetricsHook so the loss sentinels read the gauge
+    # it just set instead of paying a second device fetch.  Detection
+    # only: a firing bumps counters, dumps a flight, and (under a
+    # supervisor that exported OBS_HEALTH) refreshes the health.json
+    # the fleet reads for its skew/straggler pass.
+    from distributedtensorflowexample_tpu.training.hooks import AnomalyHook
+    hooks.append(AnomalyHook(every=cfg.log_every,
+                             health_path=os.environ.get("OBS_HEALTH", "")))
     rec = obs_recorder.maybe_install()
     if rec is not None:
         # (rank, attempt, phase land in the flight payload itself —

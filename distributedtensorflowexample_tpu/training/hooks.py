@@ -187,16 +187,26 @@ class MetricsHook(Hook):
             self._coll_ops_per_step = collectives["total_count_per_step"]
             self._coll_bytes_per_step = collectives[
                 "total_out_bytes_per_step"]
+        # Anatomy counters (registration is idempotent: these resolve to
+        # the SAME families training/loop.py feeds) — the "steps" event
+        # carries their per-window deltas so obs/timeline.step_anatomy
+        # can decompose each window without the full registry.
+        self._in_c = obs_metrics.counter("loop_input_seconds_total")
+        self._stp_c = obs_metrics.counter("loop_step_seconds_total")
+        self._hk_c = obs_metrics.counter("loop_hook_seconds_total")
         self._due = _EveryN(self._every)
         self._last_step = 0
         self._last_t = self._mark_t = time.perf_counter()
         self._mark_step = 0
+        self._mark_cat = (0.0, 0.0, 0.0)
         self._prev_snap = None
 
     def begin(self, loop) -> None:
         self._due = _EveryN(self._every, int(loop.start_step))
         self._last_step = self._mark_step = int(loop.start_step)
         self._last_t = self._mark_t = time.perf_counter()
+        self._mark_cat = (self._in_c.value, self._stp_c.value,
+                          self._hk_c.value)
         self._prev_snap = None
         rec = obs_recorder.get()
         if rec is not None:
@@ -222,8 +232,18 @@ class MetricsHook(Hook):
                 self._loss_g.set(lossf)
                 if rec is not None:
                     rec.record_loss(step, lossf)
+            # Anatomy deltas since the last mark.  input/compute include
+            # this boundary (the loop feeds them pre-hooks); the hook
+            # counter's window for THIS boundary is still open, so the
+            # hook column covers up to the previous boundary — the
+            # tie-out contract in DESIGN.md §16 and tests/test_obs.py.
+            cat = (self._in_c.value, self._stp_c.value, self._hk_c.value)
             obs_trace.event("steps", now - self._mark_t,
-                            step=step, n=step - self._mark_step)
+                            step=step, n=step - self._mark_step,
+                            input_s=round(cat[0] - self._mark_cat[0], 6),
+                            compute_s=round(cat[1] - self._mark_cat[1], 6),
+                            hook_s=round(cat[2] - self._mark_cat[2], 6))
+            self._mark_cat = cat
             self._mark_step = step
             self._mark_t = now
             if rec is not None:
@@ -234,3 +254,91 @@ class MetricsHook(Hook):
                             self._prev_snap, snap))
                 self._prev_snap = snap
         return False
+
+
+class AnomalyHook(Hook):
+    """Online anomaly detection at loop boundaries (obs/anomaly.py):
+    step-time EWMA regression against the run's own warmup-pinned
+    baseline, NaN and loss-plateau sentinels — detection only, never a
+    stop (NaNGuardHook owns the kill; this hook owns the evidence).
+
+    Per-boundary cost is a handful of float ops (the same lock-free
+    budget as MetricsHook, guarded with it in tests/test_obs.py).
+    Everything heavier fires only at ``every``-step marks: the loss
+    sentinels read the ``train_loss`` gauge MetricsHook just set
+    (install this hook AFTER MetricsHook — trainers/common.py and
+    faultline do — so no second device fetch is ever paid), and
+    ``health_path`` gets an atomic health.json rewrite.  A NEW firing
+    additionally bumps ``anomaly_flags_total``, emits an ``anomaly``
+    trace event, and dumps a flight (``final=False``) so the postmortem
+    ring covers the steps around the anomaly, not just the death.
+
+    The regression detector's window EXCLUDES checkpoint/snapshot/eval
+    span time (read as sum deltas from the ``span_seconds`` histogram
+    the spans already feed): a periodic save is seconds against sub-ms
+    steps, so the first post-warmup checkpoint would otherwise score as
+    a guaranteed false regression against the warmup-pinned baseline —
+    MetricsHook makes the same exclusion for throughput via
+    ``logger.exclude``."""
+
+    _EXCLUDED_SPANS = ("checkpoint", "snapshot", "eval")
+
+    def __init__(self, every: int = 1, health_path: str = "",
+                 health=None):
+        from distributedtensorflowexample_tpu.obs import anomaly
+        self._anomaly = anomaly
+        self._every = max(1, every)
+        self._health_path = health_path
+        self._health = health or anomaly.RunHealth()
+        self._loss_g = obs_metrics.gauge("train_loss")
+        self._spans = [obs_metrics.histogram("span_seconds").labels(name=n)
+                       for n in self._EXCLUDED_SPANS]
+        self._due = _EveryN(self._every)
+        self._last_step = 0
+        self._last_t = time.perf_counter()
+        self._last_excl = sum(c.sum for c in self._spans)
+
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._every, int(loop.start_step))
+        self._last_step = int(loop.start_step)
+        self._last_t = time.perf_counter()
+        self._last_excl = sum(c.sum for c in self._spans)
+
+    def _fired(self, kinds: list, step: int) -> None:
+        for kind in kinds:
+            self._anomaly.FLAGS_TOTAL.labels(kind=kind).inc()
+            obs_trace.event("anomaly", 0.0, step=step, kind=kind,
+                            z=round(self._health.step_time.z, 3))
+            obs_recorder.dump_global(f"anomaly_{kind}", final=False)
+
+    def after_step(self, step, state, metrics) -> bool:
+        now = time.perf_counter()
+        excl = sum(c.sum for c in self._spans)
+        window = max(0.0, (now - self._last_t)
+                     - (excl - self._last_excl))
+        fired = self._health.observe_window(step, step - self._last_step,
+                                            window)
+        self._last_step = step
+        self._last_t = now
+        self._last_excl = excl
+        if self._due(step):
+            st = self._health.step_time
+            if st.armed:
+                self._anomaly.STEP_TIME_Z.set(round(st.z, 3))
+            # The gauge MetricsHook set this same boundary; untouched
+            # (monotonic_ts None) means no loss has been sampled yet.
+            if self._loss_g._bare.monotonic_ts is not None:
+                fired += self._health.observe_loss(
+                    step, float(self._loss_g.value))
+            if fired:
+                self._fired(fired, step)
+            if self._health_path:
+                self._health.write(self._health_path)
+        elif fired:
+            self._fired(fired, step)
+        return False
+
+    def end(self, state) -> None:
+        if self._health_path:
+            self._health.step = int(state.step)
+            self._health.write(self._health_path)

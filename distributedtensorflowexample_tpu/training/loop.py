@@ -14,9 +14,27 @@ from typing import Iterable, Iterator
 
 import jax
 
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.training.hooks import Hook
 from distributedtensorflowexample_tpu.training.metrics import MetricsLogger
 from distributedtensorflowexample_tpu.training.state import TrainState
+
+# Step-time anatomy counters (obs/timeline.step_anatomy's tie-out
+# surface): where each call boundary's wall time goes — the host batch
+# fetch, the train-step call (dispatch + compute + collective wait),
+# the after_step hooks.  The remainder (logging, loop bookkeeping) is
+# the anatomy table's "other".  Per boundary this costs two extra
+# perf_counter reads and three lock-free counter adds — inside the
+# MetricsHook <1% overhead budget, guarded with it in tests/test_obs.py.
+_INPUT_S = obs_metrics.counter(
+    "loop_input_seconds_total", "wall seconds fetching batches at loop "
+    "call boundaries")
+_STEP_S = obs_metrics.counter(
+    "loop_step_seconds_total", "wall seconds inside the train-step call "
+    "(dispatch + compute + collective wait)")
+_HOOK_S = obs_metrics.counter(
+    "loop_hook_seconds_total", "wall seconds in after_step hooks "
+    "(checkpoint/eval/telemetry)")
 
 
 class TrainLoop:
@@ -61,11 +79,25 @@ class TrainLoop:
                               self._spc):
                 if self._should_stop is not None and self._should_stop():
                     break
-                state, metrics = self._train_step(state, next(self._batches))
+                t0 = time.perf_counter()
+                batch = next(self._batches)
+                t1 = time.perf_counter()
+                state, metrics = self._train_step(state, batch)
+                t2 = time.perf_counter()
                 if self._prefetch is not None:
                     # AFTER the step dispatch: the perm updates enqueue
-                    # behind the in-flight step and overlap it.
+                    # behind the in-flight step and overlap it.  Outside
+                    # the t1..t2 window — its host cost is loop
+                    # bookkeeping (the anatomy "other" column), not the
+                    # train-step call.
                     self._prefetch()
+                # Input/step fed BEFORE the hooks run, so MetricsHook's
+                # log-boundary "steps" event reads deltas that include
+                # THIS boundary; the hook counter necessarily lands
+                # after (its window is still open here) — the anatomy
+                # hook column therefore trails one boundary (DESIGN §16).
+                _INPUT_S.inc(t1 - t0)
+                _STEP_S.inc(t2 - t1)
                 self._logger.maybe_log(step, metrics)
                 # Every hook sees every step (no short-circuit) — a stop
                 # request must not mask another hook's work at the same
@@ -75,7 +107,9 @@ class TrainLoop:
                 t_hooks = time.perf_counter()
                 stops = [h.after_step(step, state, metrics)
                          for h in self._hooks]
-                self._logger.exclude(time.perf_counter() - t_hooks)
+                dt_hooks = time.perf_counter() - t_hooks
+                _HOOK_S.inc(dt_hooks)
+                self._logger.exclude(dt_hooks)
                 if any(stops):
                     break
         except KeyboardInterrupt as e:

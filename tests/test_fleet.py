@@ -478,3 +478,60 @@ def test_faultline_honors_fleet_resume_step(tmp_path, capsys, monkeypatch):
                          "--workdir", wd, "--seed", "0")
     assert rc == 1
     assert "not valid in this rank's store" in rec["_stderr"]
+
+
+@pytest.mark.timeline
+def test_poll_health_stale_beat_evidence_is_cadence_gated(tmp_path):
+    """The stalled-heartbeat straggler evidence is gated twice: a rank
+    that EXITED is never evidenced by its (necessarily) stopped beat,
+    and a live rank's no-beat span only counts once it exceeds
+    skew_time_ratio x that rank's OWN observed beat cadence — raw
+    heartbeat age at a coarse beat cadence (production trainers beat
+    every ~64 steps) is noise, not evidence.  A live rank whose beat
+    then genuinely freezes IS named, with the stall in the journal."""
+    from distributedtensorflowexample_tpu.obs import anomaly as obs_anomaly
+    fleet = _fleet(tmp_path, health_path="", skew_lag_steps=3,
+                   skew_time_ratio=4.0)
+    fleet._stragglers, fleet._flagged = set(), set()
+    fleet._beat_obs = {}
+
+    def _poll(**kw):
+        fleet._health_polled_t = -float("inf")
+        fleet._poll_health("t", 0, [0, 1], **kw)
+
+    for rank, last in ((0, 12), (1, 5)):       # rank 1 frozen at step 5
+        h = obs_anomaly.RunHealth(rank=rank)
+        for s in range(1, last + 1):
+            h.observe_window(s, 1, 0.01)       # healthy 10ms steps
+        h.write(fleet._health_path(rank))
+        open(fleet._hb_path(rank), "w").close()
+    _poll()                                    # learn mtimes
+    assert fleet._stragglers == set()          # no cadence known yet
+    time.sleep(0.05)
+    now = time.time()
+    for rank in (0, 1):                        # one beat each: cadence
+        os.utime(fleet._hb_path(rank), (now, now))
+    _poll()                                    # interval ~0.05 s learned
+    assert fleet._stragglers == set()
+    time.sleep(0.3)                            # rank 1's beat freezes
+    now = time.time()
+    os.utime(fleet._hb_path(0), (now, now))
+    _poll(exited={1: 143})                     # exited: never evidence
+    assert fleet._stragglers == set()
+    _poll(exited={})                           # live + frozen: named
+    assert fleet._stragglers == {1}
+    events = _journal_events(tmp_path)
+    strag = [e for e in events if e.get("kind") == "straggler"]
+    assert [e["rank"] for e in strag] == [1]
+    assert "stale" in strag[0]["why"]
+    # a TRANSIENT detector firing (fired_step latched, firing already
+    # decayed below threshold between 0.5 s polls) still annotates the
+    # journal — the same fired-or-firing read obs_report renders
+    h = obs_anomaly.read_health(fleet._health_path(1))
+    h["flags"]["step_time_regression"] = {"firing": False,
+                                          "fired_step": 4}
+    obs_anomaly.write_health(fleet._health_path(1), h)
+    _poll(exited={})
+    assert any(e.get("kind") == "step_time_regression"
+               and e.get("rank") == 1
+               for e in _journal_events(tmp_path))

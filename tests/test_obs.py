@@ -15,6 +15,7 @@ no collectives — these verdicts must land ahead of the isolated
 wrappers inside the tier-1 budget.
 """
 
+import glob
 import json
 import os
 import signal
@@ -31,9 +32,11 @@ import pytest
 
 from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
 from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.obs import anomaly as obs_anomaly
 from distributedtensorflowexample_tpu.obs import export as obs_export
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs import timeline as obs_timeline
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
 from distributedtensorflowexample_tpu.parallel.sync import make_train_step
 from distributedtensorflowexample_tpu.resilience import (
@@ -41,7 +44,8 @@ from distributedtensorflowexample_tpu.resilience import (
     tear_journal)
 from distributedtensorflowexample_tpu.resilience.supervisor import (
     Journal, RetryPolicy)
-from distributedtensorflowexample_tpu.training.hooks import MetricsHook
+from distributedtensorflowexample_tpu.training.hooks import (AnomalyHook,
+                                                             MetricsHook)
 from distributedtensorflowexample_tpu.training.loop import TrainLoop
 from distributedtensorflowexample_tpu.training.state import TrainState
 
@@ -381,17 +385,23 @@ def test_metrics_hook_overhead_under_1pct_of_bench_step(sgd_step):
         jax.block_until_ready(metrics)
         times.append(time.perf_counter() - t0)
     step_s = min(times)
+    # The FULL round-10 production stack at boundary cadence:
+    # MetricsHook + AnomalyHook (trainers/common.py installs both) —
+    # the <1% budget covers the anomaly detectors' hot-path half too.
     hook = MetricsHook(every=100)
+    anom = AnomalyHook(every=100)
     hook.begin(_FakeLoop())
+    anom.begin(_FakeLoop())
     fetched = {"loss": np.asarray(metrics["loss"])}
     n = 1000
     t0 = time.perf_counter()
     for i in range(1, n + 1):
         hook.after_step(i, state, fetched)
+        anom.after_step(i, state, fetched)
     hook_s = (time.perf_counter() - t0) / n
     assert hook_s < 0.01 * step_s, (
-        f"metric-hook {hook_s * 1e6:.2f}us/boundary >= 1% of the "
-        f"{step_s * 1e3:.1f}ms CPU bench step")
+        f"metric+anomaly hooks {hook_s * 1e6:.2f}us/boundary >= 1% of "
+        f"the {step_s * 1e3:.1f}ms CPU bench step")
 
 
 # --- satellite: disk-full snapshot save ------------------------------------
@@ -643,3 +653,566 @@ def test_obs_report_cli_help_runs():
         [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
          "--help"], capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0 and "--journal" in proc.stdout
+
+
+# === round 10: timeline merge + online anomaly detection ====================
+
+timeline_mark = pytest.mark.timeline
+
+
+@timeline_mark
+def test_ewma_regression_pins_baseline_and_latches():
+    """The boiled-frog defense: the baseline is pinned over the first
+    ``warmup`` samples and NEVER updates, so a later sustained slowdown
+    scores against the run's own healthy start; ``observe`` returns True
+    exactly once (the latch) while ``firing`` tracks the live z."""
+    det = obs_anomaly.EwmaRegression(warmup=4, alpha=1.0, z_thresh=4.0,
+                                     skip_first=0)
+    fired = [det.observe(0.010, step=s) for s in range(1, 5)]
+    assert fired == [False] * 4 and det.armed
+    mu0, sigma0 = det.mu0, det.sigma0
+    assert mu0 == pytest.approx(0.010)
+    assert not det.observe(0.010, step=5) and det.z == pytest.approx(0.0)
+    assert det.observe(0.050, step=6)            # first crossing fires
+    assert det.fired_step == 6 and det.firing
+    assert not det.observe(0.060, step=7)        # latched: never re-fires
+    assert det.firing and det.fired_step == 6
+    assert (det.mu0, det.sigma0) == (mu0, sigma0)  # baseline still pinned
+    payload = det.payload()
+    assert payload["fired_step"] == 6 and payload["firing"]
+    assert payload["baseline_mean_s"] == pytest.approx(0.010)
+
+
+@timeline_mark
+def test_ewma_sigma_floor_and_skip_first():
+    """Near-constant warmup samples must not turn scheduler jitter into
+    a flag (sigma floored at min_sigma_frac * mean), and the compile-
+    dominated first boundary is skipped without feeding the baseline."""
+    det = obs_anomaly.EwmaRegression(warmup=3, z_thresh=8.0, skip_first=1,
+                                     min_sigma_frac=0.05)
+    assert not det.observe(9.0, step=1)          # compile window: skipped
+    assert det.n == 0 and det.ewma is None
+    for s in (2, 3, 4):
+        det.observe(0.020, step=s)
+    assert det.sigma0 == pytest.approx(0.05 * 0.020)   # floored, not 0
+    det.observe(0.021, step=5)                   # 5% jitter: z ~ 1, quiet
+    assert not det.firing
+
+
+@timeline_mark
+def test_detect_skew_laggard_vs_straggler():
+    """Lag alone names a laggard, never a straggler: the straggler
+    verdict needs slowness evidence (own regression flag, or step time
+    over time_ratio x the OTHER ranks' median — self-excluded so a
+    2-rank fleet's straggler cannot mask itself)."""
+    # fewer than two reporters: skew is a relation, nothing to say
+    assert obs_anomaly.detect_skew({0: {"step": 8}})["stragglers"] == []
+    # lagging but no evidence (still compiling / unlucky sample)
+    out = obs_anomaly.detect_skew(
+        {0: {"step": 10, "step_time_s": 0.01},
+         1: {"step": 6, "step_time_s": None}}, lag_steps=3)
+    assert out["laggards"] == [1] and out["stragglers"] == []
+    assert "no slowness evidence" in out["why"][1]
+    # lagging with its own regression firing
+    out = obs_anomaly.detect_skew(
+        {0: {"step": 10, "step_time_s": 0.01},
+         1: {"step": 6, "step_time_s": 0.3, "regression_firing": True}},
+        lag_steps=3)
+    assert out["stragglers"] == [1] and out["max_step"] == 10
+    assert out["lag_steps"] == {0: 0, 1: 4}
+    assert "regression firing" in out["why"][1]
+    # lagging + slow vs the other ranks' median (no flag of its own)
+    out = obs_anomaly.detect_skew(
+        {0: {"step": 10, "step_time_s": 0.01},
+         1: {"step": 5, "step_time_s": 0.25}}, lag_steps=3,
+        time_ratio=4.0)
+    assert out["stragglers"] == [1]
+    assert "fleet median" in out["why"][1]
+    # lagging + stale heartbeat (wedged-but-alive: its health report
+    # predates the stall so step_time_s looks healthy, but the beat —
+    # touched every boundary — has gone stale)
+    out = obs_anomaly.detect_skew(
+        {0: {"step": 10, "step_time_s": 0.01, "hb_age_s": 0.01},
+         1: {"step": 5, "step_time_s": 0.01, "hb_age_s": 7.0}},
+        lag_steps=3, time_ratio=4.0)
+    assert out["stragglers"] == [1]
+    assert "heartbeat" in out["why"][1] and "stale" in out["why"][1]
+    # under the lag threshold nothing is even a laggard
+    out = obs_anomaly.detect_skew(
+        {0: {"step": 10, "step_time_s": 0.01},
+         1: {"step": 9, "step_time_s": 9.9, "regression_firing": True}},
+        lag_steps=3)
+    assert out["laggards"] == [] and out["stragglers"] == []
+
+
+@timeline_mark
+def test_plateau_nan_sentinels_and_spread_fraction():
+    det = obs_anomaly.PlateauSentinel(window=3, min_delta=1e-3)
+    for s, loss in enumerate((1.0, 0.9, 0.8, 0.7), start=1):
+        assert not det.observe(loss, step=s)     # still improving
+    assert not det.observe(0.7, step=5)
+    assert not det.observe(0.7, step=6)          # 0.8 still pre-window best
+    assert det.observe(0.7, step=7)              # window best == best_before
+    assert det.fired_step == 7
+    assert not det.observe(0.7, step=8)          # still firing: edge only
+    # NaN is the other sentinel's job and must not poison the window
+    assert not det.observe(float("nan"), step=7)
+    # improve -> the window re-arms -> a SECOND plateau fires again
+    for s, loss in enumerate((0.5, 0.4, 0.3, 0.3), start=9):
+        assert not det.observe(loss, step=s)
+    assert not det.firing
+    assert det.observe(0.3, step=13) or det.observe(0.3, step=14)
+    assert det.firing and det.fired_step == 7    # first plateau pinned
+
+    rh = obs_anomaly.RunHealth(rank=3)
+    assert rh.observe_loss(4, float("nan")) == ["nan_loss"]
+    assert rh.observe_loss(5, float("nan")) == []        # latched
+    assert rh.flags["nan_loss"] == {"firing": True, "fired_step": 4}
+
+    assert obs_anomaly.spread_fraction([100.0, 80.0]) == pytest.approx(0.2)
+    assert obs_anomaly.spread_fraction([50.0]) == 0.0
+    assert obs_anomaly.spread_fraction([]) == 0.0
+    # tolerant-reader contract: a malformed record (string repeats,
+    # None) must not crash the ratchet's verdict protocol
+    assert obs_anomaly.spread_fraction(["1.2", None, 100.0, 80.0]) == \
+        pytest.approx(0.2)
+
+
+@timeline_mark
+def test_health_json_roundtrip_and_tolerant_read(tmp_path):
+    rh = obs_anomaly.RunHealth(rank=1)
+    rh.observe_window(5, 1, 0.01)
+    path = str(tmp_path / "health.json")
+    rh.write(path)
+    payload = obs_anomaly.read_health(path)
+    assert payload["kind"] == "rank" and payload["rank"] == 1
+    assert payload["step"] == 5 and payload["version"] == 1
+    assert set(payload["flags"]) == {"step_time_regression", "nan_loss",
+                                     "loss_plateau"}
+    # tolerant by contract: missing and torn both read as None
+    assert obs_anomaly.read_health(str(tmp_path / "absent.json")) is None
+    (tmp_path / "torn.json").write_text('{"version": 1, "ste')
+    assert obs_anomaly.read_health(str(tmp_path / "torn.json")) is None
+
+
+@timeline_mark
+def test_span_events_carry_both_clocks_pinned_bitwise(sink, tmp_path,
+                                                      monkeypatch):
+    """The satellite clock fix: every span event carries t0_s (monotonic
+    — honest durations) AND t0_unix (wall — the cross-process alignment
+    axis), derived through the _now/_wall seams so a pinned-clock test
+    still gets bitwise-stable flight dumps."""
+    monkeypatch.setattr(obs_metrics, "_now", lambda: 100.0)
+    monkeypatch.setattr(obs_metrics, "_wall", lambda: 1700000000.0)
+    ev = obs_trace.event("win", 2.5)
+    assert ev["t0_s"] == 97.5
+    assert ev["t0_unix"] == 1699999997.5         # same instant, wall axis
+    with obs_trace.span("s"):
+        pass
+    assert sink[-1]["t0_unix"] == 1700000000.0
+    reg = obs_metrics.MetricsRegistry()
+    rec = obs_recorder.FlightRecorder(registry=reg)
+    rec.record_span(ev)
+    p1 = rec.dump("manual", path=str(tmp_path / "f1.json"))
+    p2 = rec.dump("manual", path=str(tmp_path / "f2.json"))
+    raw1, raw2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert raw1 == raw2                          # bitwise under pinned clock
+    flight = json.loads(raw1)
+    assert flight["start_unix"] == 1700000000.0
+    assert flight["spans"][0]["t0_unix"] == 1699999997.5
+
+
+@timeline_mark
+def test_fleet_dir_sources_health_discovery_stays_in_bounds(tmp_path):
+    """Health discovery covers the fleet layout (<workdir>/health*.json
+    next to a <workdir>/flight dir) but must NOT glob the journal
+    directory's parent: a default workdir of /tmp/fleet would otherwise
+    merge some other process's /tmp/health.json into this report."""
+    wd = tmp_path / "fleet"
+    (wd / "flight").mkdir(parents=True)
+    (wd / "health.json").write_text("{}")
+    (wd / "health_rank0.json").write_text("{}")
+    foreign = tmp_path / "health.json"           # parent of the workdir
+    foreign.write_text("{}")
+    src = obs_timeline.fleet_dir_sources(
+        flight_dir=str(wd / "flight"), journal=str(wd / "fleet.jsonl"))
+    assert str(wd / "health.json") in src["health_paths"]
+    assert str(wd / "health_rank0.json") in src["health_paths"]
+    assert str(foreign) not in src["health_paths"]
+    # an arbitrary --dir (not the <workdir>/flight or <journal>_flight
+    # layouts) must not widen the glob to ITS parent either
+    src = obs_timeline.fleet_dir_sources(flight_dir=str(wd))
+    assert str(wd / "health.json") in src["health_paths"]
+    assert str(foreign) not in src["health_paths"]
+
+
+def _mini_flight(rank: int, pid: int, spans: list, coll: bool = False):
+    flight = {"rank": rank, "attempt": 0, "pid": pid, "spans": spans}
+    if coll:
+        flight["metrics"] = {"gauges": {
+            'collective_ops_per_step{op="all-reduce"}': {"value": 3},
+            'collective_bytes_per_step{op="all-reduce"}': {"value": 1024}}}
+    return flight
+
+
+@timeline_mark
+def test_timeline_merge_calibration_coverage_and_chrome_trace(tmp_path):
+    """The tentpole merge: wall-ordered cross-rank events, stamp-less
+    events calibrated from a sibling's monotonic->wall offset, torn
+    sources costed as coverage entries (never a raised report), and a
+    Perfetto/Chrome-trace export with one lane per rank."""
+    s0 = [{"name": "steps", "t0_s": 10.0, "t0_unix": 1000.0, "dur_s": 0.5,
+           "step": 2, "n": 2, "input_s": 0.1, "compute_s": 0.3,
+           "hook_s": 0.05},
+          # pre-fix event: no wall stamp — the sibling above calibrates it
+          {"name": "snapshot", "t0_s": 10.3, "dur_s": 0.03}]
+    s1 = [{"name": "steps", "t0_s": 50.0, "t0_unix": 1000.3, "dur_s": 1.5,
+           "step": 2, "n": 2, "input_s": 0.1, "compute_s": 1.3,
+           "hook_s": 0.05}]
+    (tmp_path / "flight_0_11.json").write_text(
+        json.dumps(_mini_flight(0, 11, s0, coll=True)))
+    (tmp_path / "flight_1_22.json").write_text(
+        json.dumps(_mini_flight(1, 22, s1)))
+    (tmp_path / "flight_2_33.json").write_text("{torn")
+    journal = tmp_path / "fleet.jsonl"
+    journal.write_text(json.dumps(
+        {"event": "gang_start", "ts": 999.9, "ranks": [0, 1, 2]}) + "\n"
+        + '{"event": "torn_li')
+    # An OBS_TRACE_FILE from rank 0's process: the same span closes
+    # land in the flight ring AND here (trace events carry rank from
+    # OBS_RANK but no pid) — the merge must count each close ONCE or
+    # anatomy totals double.
+    trace_file = tmp_path / "trace0.jsonl"
+    trace_file.write_text("".join(
+        json.dumps({**ev, "rank": 0, "attempt": 0}) + "\n" for ev in s0))
+    merged = obs_timeline.merge(
+        flight_paths=[str(tmp_path / f"flight_{r}_{p}.json")
+                      for r, p in ((0, 11), (1, 22), (2, 33))],
+        trace_paths=[str(trace_file)],
+        journal_paths=[str(journal)])
+    assert len(merged["events"]) == len(s0) + len(s1)    # deduped
+    assert all(e["pid"] == 11 for e in merged["events"]
+               if e["rank"] == 0)           # the flight copy was kept
+    cov = merged["coverage"]
+    assert cov["ranks_present"] == [0, 1]
+    assert cov["ranks_missing"] == [2]           # named, not raised
+    assert list(cov["unreadable"]) == [str(tmp_path / "flight_2_33.json")]
+    assert cov["torn_lines"] == 1
+    assert cov["uncalibrated_events"] == 0
+    snap = next(e for e in merged["events"] if e["name"] == "snapshot")
+    assert snap["t0_unix"] == pytest.approx(1000.3)      # offset 990.0
+    stamps = [e["t0_unix"] for e in merged["events"]]
+    assert stamps == sorted(stamps)              # wall-ordered
+    assert merged["collectives"][0]["all-reduce"] == {"ops": 3,
+                                                      "bytes": 1024}
+
+    trace = obs_timeline.chrome_trace(merged)
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    # one lane per rank + the unranked fleet lane the journal marker uses
+    assert lanes == {"rank 0", "rank 1", "fleet / unranked"}
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert xs and all(e["ts"] >= 0 for e in xs)  # relative to base stamp
+    marks = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert [m["name"] for m in marks] == ["gang_start"]
+    json.dumps(trace)                            # loadable = serializable
+
+    rows = obs_timeline.step_anatomy(merged)
+    by_rank = {r["rank"]: r for r in rows}
+    assert by_rank[1]["window_s"] == 1.5 and by_rank[0]["window_s"] == 0.5
+    assert by_rank[1]["compute_s"] > by_rank[0]["compute_s"]  # the skew
+    assert by_rank[0]["snapshot_s"] == pytest.approx(0.03)
+    assert by_rank[0]["hook_s"] == pytest.approx(0.02)  # snap broken out
+    assert by_rank[0]["collective_ops"] == 6     # 3 ops/step x n=2
+    tot = obs_timeline.anatomy_totals(rows)
+    assert tot["window_s"] == pytest.approx(2.0) and tot["n"] == 4
+
+
+@timeline_mark
+def test_step_anatomy_ties_out_against_loop_counters(sgd_step, sink):
+    """ACCEPTANCE tie-out: the per-window anatomy deltas the 'steps'
+    events carry sum to the loop_*_seconds_total counters the TrainLoop
+    feeds (input/compute exactly; the hook column trails one boundary by
+    construction — its counter is still open when the mark reads it)."""
+    reg = obs_metrics.registry()
+    in_c = reg.counter("loop_input_seconds_total")
+    stp_c = reg.counter("loop_step_seconds_total")
+    hk_c = reg.counter("loop_hook_seconds_total")
+    before = (in_c.value, stp_c.value, hk_c.value)
+    state = _fresh_state()
+    TrainLoop(sgd_step, iter(_batches(6)), 6,
+              hooks=[MetricsHook(every=2)]).run(state)
+    d_in = in_c.value - before[0]
+    d_stp = stp_c.value - before[1]
+    d_hk = hk_c.value - before[2]
+    steps_events = [e for e in sink if e["name"] == "steps"]
+    assert [e["step"] for e in steps_events] == [2, 4, 6]
+    for e in steps_events:
+        assert e["t0_unix"] is not None          # mergeable across ranks
+        assert e["input_s"] >= 0 and e["compute_s"] > 0
+
+    rows = obs_timeline.step_anatomy(
+        {"events": steps_events, "markers": [], "health": [],
+         "collectives": {}})
+    assert [(r["step_from"], r["step_to"], r["n"]) for r in rows] == [
+        (0, 2, 2), (2, 4, 2), (4, 6, 2)]
+    tot = obs_timeline.anatomy_totals(rows)
+    assert tot["input_s"] == pytest.approx(d_in, abs=1e-4)
+    assert tot["compute_s"] == pytest.approx(d_stp, abs=1e-4)
+    assert 0.0 <= tot["hook_s"] <= d_hk + 1e-6   # trails one boundary
+    for r in rows:
+        assert r["other_s"] >= 0.0               # window >= categorized sum
+        assert r["window_s"] >= r["input_s"] + r["compute_s"] - 1e-6
+
+
+@timeline_mark
+def test_anomaly_hook_fires_counters_health_and_flight(tmp_path, sink,
+                                                       monkeypatch):
+    """The hook half of the tentpole: a regression firing bumps
+    anomaly_flags_total, emits an 'anomaly' trace event, dumps a flight
+    mid-run (the ring must cover the steps AROUND the anomaly), and the
+    health.json the fleet polls carries the fired step; the NaN sentinel
+    rides the train_loss gauge MetricsHook already set — no second
+    device fetch."""
+    monkeypatch.setenv("OBS_DIR", str(tmp_path / "flight"))
+    ticks = iter([0.0]                           # begin()
+                 + [0.010 * s for s in range(1, 7)]       # 6 fast windows
+                 + [0.06 + 0.25 * k for k in range(1, 5)])  # then slow
+    monkeypatch.setattr(time, "perf_counter", lambda: next(ticks))
+    obs_metrics.gauge("train_loss").set(1.0)
+    reg = obs_metrics.registry()
+    flags_key = 'anomaly_flags_total{kind="step_time_regression"}'
+    nan_key = 'anomaly_flags_total{kind="nan_loss"}'
+    before = reg.snapshot()["counters"]
+    rh = obs_anomaly.RunHealth(
+        rank=0, step_time=obs_anomaly.EwmaRegression(
+            warmup=4, alpha=1.0, z_thresh=4.0, skip_first=0))
+    hook = AnomalyHook(every=2, health_path=str(tmp_path / "health.json"),
+                       health=rh)
+    installed = obs_recorder._GLOBAL
+    obs_recorder._GLOBAL = obs_recorder.FlightRecorder(registry=reg)
+    try:
+        for step in range(1, 7):                 # healthy: warmup + quiet
+            hook.after_step(step, None, None)
+        snap = reg.snapshot()["counters"]
+        assert snap.get(flags_key, 0) == before.get(flags_key, 0)
+        hook.after_step(7, None, None)           # first slow window: fires
+        obs_metrics.gauge("train_loss").set(float("nan"))
+        hook.after_step(8, None, None)           # due mark: NaN sentinel
+        snap = reg.snapshot()["counters"]
+        assert snap.get(flags_key, 0) - before.get(flags_key, 0) == 1
+        assert snap.get(nan_key, 0) - before.get(nan_key, 0) == 1
+    finally:
+        obs_recorder._GLOBAL = installed
+    kinds = [e["kind"] for e in sink if e["name"] == "anomaly"]
+    assert kinds == ["step_time_regression", "nan_loss"]
+    assert rh.step_time.fired_step == 7 and rh.nan_step == 8
+    flights = glob.glob(str(tmp_path / "flight" / "flight_*.json"))
+    assert flights                               # dumped mid-run, pre-death
+    assert json.load(open(flights[0]))["reason"].startswith("anomaly_")
+    health = obs_anomaly.read_health(str(tmp_path / "health.json"))
+    assert health["flags"]["step_time_regression"]["fired_step"] == 7
+    assert health["flags"]["nan_loss"] == {"firing": True, "fired_step": 8}
+    z = reg.snapshot()["gauges"]["anomaly_step_time_z"]["value"]
+    assert z > 4.0
+
+
+def _bench_ratchet():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_ratchet
+    finally:
+        sys.path.pop(0)
+    return bench_ratchet
+
+
+def _write_record(path, value, metric="steps_per_sec_per_chip", **detail):
+    rec = {"metric": metric, "value": value, "unit": "steps/s/chip",
+           "detail": detail}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+@timeline_mark
+def test_bench_ratchet_explains_variance_gates_regressions(tmp_path,
+                                                           capsys):
+    """The trajectory guard: a raw drop with the window-normalized
+    vs_roofline held is chip variance (explained); roofline regressed or
+    absent is UNEXPLAINED (exit 1); a self-noisy measurement
+    (spread_frac over --noise) or a documented OUTAGE round can never
+    gate."""
+    rt = _bench_ratchet()
+    d = str(tmp_path)
+    floor = str(tmp_path / "floor.json")
+    json.dump({"dots_passed_floor": 220}, open(floor, "w"))
+    _write_record(os.path.join(d, "BENCH_x_r01.json"), 100.0,
+                  vs_roofline=0.50, platform="chip")
+    # sentinel lines are not measurements
+    with open(os.path.join(d, "BENCH_x_r01.json"), "a") as f:
+        f.write(json.dumps({"metric": "steps_per_sec_per_chip",
+                            "unit": "unavailable"}) + "\n")
+    _write_record(os.path.join(d, "BENCH_x_r02.json"), 50.0,
+                  vs_roofline=0.55, platform="chip")
+    common = ["--records_dir", d, "--floor_file", floor]
+    assert rt.main(common + ["--json"]) == 0     # roofline held: explained
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["findings"][0]["severity"] == "explained"
+    assert "vs_roofline held" in verdict["findings"][0]["why"]
+
+    _write_record(os.path.join(d, "BENCH_x_r03.json"), 40.0,
+                  vs_roofline=0.20, platform="chip")
+    assert rt.main(common + ["--json"]) == 1     # roofline regressed too
+    verdict = json.loads(capsys.readouterr().out)
+    worst = [f for f in verdict["findings"] if f["severity"] == "regression"]
+    assert worst and "vs_roofline also regressed" in worst[0]["why"]
+
+    # the same drop measured noisily cannot gate
+    _write_record(os.path.join(d, "BENCH_x_r04.json"), 40.0,
+                  vs_roofline=0.20, platform="chip",
+                  repeats=[10.0, 40.0])          # spread 0.75 > 0.25
+    assert rt.main(common + ["--json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert all(f["severity"] != "regression" for f in verdict["findings"])
+
+    # a checked-in outage postmortem adjudicates its whole round
+    _write_record(os.path.join(d, "BENCH_x_r05.json"), 30.0,
+                  platform="chip")
+    open(os.path.join(d, "OUTAGE_r05.md"), "w").write("degraded window")
+    assert rt.main(common + ["--json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert any("documented outage" in f["why"]
+               for f in verdict["findings"])
+
+
+@timeline_mark
+def test_bench_ratchet_floor_gates_and_ratchets_upward_only(tmp_path,
+                                                            capsys):
+    rt = _bench_ratchet()
+    floor = str(tmp_path / "floor.json")
+    json.dump({"dots_passed_floor": 220}, open(floor, "w"))
+    common = ["--records_dir", str(tmp_path), "--floor_file", floor]
+    assert rt.main(common + ["--dots", "220"]) == 0
+    assert rt.main(common + ["--dots", "219"]) == 1      # below the floor
+    out = capsys.readouterr().out
+    assert "FLOOR VIOLATION" in out
+    assert rt.main(common + ["--raise_floor", "219"]) == 1   # refuses down
+    assert json.load(open(floor))["dots_passed_floor"] == 220
+    assert rt.main(common + ["--raise_floor", "224"]) == 0
+    assert json.load(open(floor))["dots_passed_floor"] == 224
+    # the repo's checked-in floor file is the tool's default target
+    checked_in = json.load(open(os.path.join(REPO, "tests",
+                                             "tier1_floor.json")))
+    assert checked_in["dots_passed_floor"] >= 220
+
+
+@timeline_mark
+def test_obs_report_renders_gaps_and_exports_trace(tmp_path, monkeypatch):
+    """The torn-flight satellite end-to-end: a fleet dir with one good
+    flight, one torn flight, and a health.json renders the ranks it HAS
+    and lists the gaps — and --format trace/json export the same merge
+    machine-readably."""
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    monkeypatch.setattr(obs_metrics, "_now", lambda: 50.0)
+    monkeypatch.setattr(obs_metrics, "_wall", lambda: 1700000100.0)
+    monkeypatch.setenv("OBS_RANK", "0")
+    rec = obs_recorder.FlightRecorder(registry=obs_metrics.MetricsRegistry())
+    rec.record_span({"name": "steps", "t0_s": 49.0, "t0_unix": 1700000099.0,
+                     "dur_s": 1.0, "step": 4, "n": 2, "input_s": 0.2,
+                     "compute_s": 0.7, "hook_s": 0.05})
+    rec.record_loss(4, 1.5)
+    rec.dump("exit", path=str(flight_dir / "flight_0_11.json"))
+    (flight_dir / "flight_1_22.json").write_text('{"rank": 1, "spa')
+    rh = obs_anomaly.RunHealth(rank=0)
+    rh.observe_window(4, 1, 0.01)
+    rh.write(str(flight_dir / "health_rank0.json"))
+    monkeypatch.delenv("OBS_RANK")
+
+    def _report(*extra):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+             "--dir", str(flight_dir), *extra],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    md = _report()
+    assert "Merged timeline" in md
+    assert "ranks present**: [0]" in md
+    assert "ranks MISSING" in md and "[1]" in md          # the gap list
+    assert "unreadable" in md and "flight_1_22.json" in md
+    assert "Step anatomy" in md and "Health" in md
+    trace = json.loads(_report("--format", "trace"))
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+    assert trace["otherData"]["coverage"]["ranks_missing"] == [1]
+    merged = json.loads(_report("--format", "json"))
+    assert merged["coverage"]["ranks_present"] == [0]
+    assert merged["anatomy"][0]["step_to"] == 4
+    assert merged["health"][0]["rank"] == 0
+
+
+@timeline_mark
+def test_merge_and_exports_tolerate_string_ranks(tmp_path):
+    """OBS_RANK need not be numeric (trace._context and the flight
+    writer both keep e.g. "chief" as-is): coverage sorts, the anatomy
+    sort, and Perfetto lane assignment must survive mixed int/str ranks
+    instead of raising mid-outage."""
+    evs = [{"name": "steps", "t0_s": 1.0, "t0_unix": 1000.0, "dur_s": 0.5,
+            "step": 2, "n": 2, "rank": 0, "input_s": 0.1,
+            "compute_s": 0.3, "hook_s": 0.0},
+           {"name": "steps", "t0_s": 2.0, "t0_unix": 1000.6, "dur_s": 0.5,
+            "step": 2, "n": 2, "rank": "chief", "input_s": 0.1,
+            "compute_s": 0.3, "hook_s": 0.0}]
+    tf = tmp_path / "t.jsonl"
+    tf.write_text("".join(json.dumps(e) + "\n" for e in evs))
+    merged = obs_timeline.merge(trace_paths=[str(tf)])
+    assert merged["coverage"]["ranks_present"] == [0, "chief"]
+    rows = obs_timeline.step_anatomy(merged)
+    assert [r["rank"] for r in rows] == [0, "chief"]
+    trace = obs_timeline.chrome_trace(merged)
+    xs = {e["pid"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert len(xs) == 2 and 0 in xs              # distinct int lanes
+
+
+@timeline_mark
+def test_obs_report_health_only_invocation(tmp_path):
+    """Health files alone are renderable input: a postmortem where the
+    flights tore away but health.json survived must not exit 2."""
+    rh = obs_anomaly.RunHealth(rank=0)
+    rh.observe_window(4, 1, 0.01)
+    path = tmp_path / "health_rank0.json"
+    rh.write(str(path))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+         "--health", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "Health" in proc.stdout and "rank 0" in proc.stdout
+
+
+@timeline_mark
+def test_anomaly_hook_excludes_save_spans_from_step_time(monkeypatch):
+    """A periodic checkpoint is seconds against sub-ms steps: without
+    excluding checkpoint/snapshot/eval span time from the detector's
+    window, the first post-warmup save would score as a guaranteed
+    false regression against the warmup-pinned baseline.  A genuinely
+    slow window (no span accounting for it) still fires."""
+    clock = {"t": 0.0}
+    monkeypatch.setattr(time, "perf_counter", lambda: clock["t"])
+    hook = AnomalyHook(every=1)
+    hook._health.step_time = obs_anomaly.EwmaRegression(
+        warmup=4, z_thresh=8.0, skip_first=0)
+    hook.begin(_FakeLoop())
+    snap = obs_metrics.histogram("span_seconds").labels(name="snapshot")
+    for s in range(1, 6):
+        clock["t"] += 0.01
+        hook.after_step(s, None, {})
+    assert hook._health.step_time.armed
+    clock["t"] += 5.01                       # 5 s of it inside the save
+    snap.observe(5.0)
+    hook.after_step(6, None, {})
+    assert not hook._health.step_time.firing     # excluded: not a regression
+    clock["t"] += 5.0                        # unexplained 5 s window
+    hook.after_step(7, None, {})
+    assert hook._health.step_time.firing
+    assert hook._health.step_time.fired_step == 7

@@ -133,7 +133,7 @@ def main(argv: list[str] | None = None) -> int:
     from distributedtensorflowexample_tpu.resilience.faults import (
         tear_journal)
     from distributedtensorflowexample_tpu.training.hooks import (
-        HeartbeatHook, MetricsHook)
+        AnomalyHook, HeartbeatHook, MetricsHook)
     from distributedtensorflowexample_tpu.training.loop import TrainLoop
     from distributedtensorflowexample_tpu.training.state import TrainState
     from distributedtensorflowexample_tpu.utils.signals import sigterm_flag
@@ -207,7 +207,17 @@ def main(argv: list[str] | None = None) -> int:
     # poisoned step, so no snapshot of a non-finite state ever reaches
     # disk; FaultInjectionHook goes last so the step that a
     # preemption/wedge covers is already snapshotted.
-    hooks = [MetricsHook(every=1), NaNGuardHook(), tape,
+    # AnomalyHook right after MetricsHook (it reads the loss gauge the
+    # latter sets) and BEFORE FaultInjectionHook: an injected slow_rank
+    # delay lands in the NEXT boundary's window sample, so the per-rank
+    # health.json a fleet drill reads (OBS_HEALTH, exported by the
+    # fleet supervisor) flags the straggler while it is still running.
+    from distributedtensorflowexample_tpu.obs.anomaly import RunHealth
+    hooks = [MetricsHook(every=1),
+             AnomalyHook(every=1,
+                         health_path=os.environ.get("OBS_HEALTH", ""),
+                         health=RunHealth(rank=rank)),
+             NaNGuardHook(), tape,
              SnapshotHook(store, every=args.snapshot_every,
                           cursor={"seed": args.seed}),
              FaultInjectionHook(plan)]

@@ -4,6 +4,12 @@
   python tools/obs_report.py /tmp/flight_1234.json
   python tools/obs_report.py --dir /tmp/supervise_capture_flight \
       --journal /tmp/supervise_capture.jsonl
+  # cross-rank Perfetto trace (load at ui.perfetto.dev or
+  # chrome://tracing): one lane per rank, one track per attempt
+  python tools/obs_report.py --dir /tmp/fleet/flight \
+      --journal /tmp/fleet/fleet.jsonl --format trace > fleet.trace.json
+  # machine-readable merge (events + anatomy + health + coverage)
+  python tools/obs_report.py --dir /tmp/fleet/flight --format json
 
 Reads the ``flight_<pid>.json`` dumps the obs recorder leaves behind
 (one per dead run; see distributedtensorflowexample_tpu/obs/) and
@@ -15,6 +21,16 @@ which rank died first, what tore the gang down, which step the restart
 agreed on — so one page answers the questions rounds 3-5 needed grep
 archaeology for: what died, at which step, on which attempt.
 
+Round 10 (obs/timeline.py + obs/anomaly.py): every invocation also
+MERGES the sources into one cross-rank wall-clock-aligned timeline —
+``--format trace`` exports it as Perfetto/Chrome-trace JSON,
+``--format json`` as the raw merge, and the default markdown gains a
+coverage section (which ranks are present, which flights are missing
+or torn — a fleet postmortem renders the ranks it HAS and lists the
+gaps instead of failing), a per-step anatomy table (input / compute /
+hook / snapshot / other + the compiled collective schedule), and a
+health section from any ``health*.json`` found next to the sources.
+
 Stdlib-only and read-only: safe to run on the box mid-outage.
 """
 
@@ -24,8 +40,13 @@ import argparse
 import glob
 import json
 import os
-import re
 import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtensorflowexample_tpu.obs import timeline as obs_timeline  # noqa: E402
 
 
 def _table(headers: list[str], rows: list[list]) -> list[str]:
@@ -41,8 +62,9 @@ def _fmt_num(v) -> str:
     return str(v)
 
 
-_COLL_SERIES = re.compile(
-    r'^collective_(ops|bytes)_per_step\{op="([^"]+)"\}$')
+# One parser for the collective series-key shape (obs/timeline.py owns
+# it — per_rank_collectives parses the same gauges out of flights).
+_COLL_SERIES = obs_timeline.COLL_SERIES_RE
 
 
 def render_collectives(counters: dict, gauges: dict) -> list[str]:
@@ -219,6 +241,92 @@ def render_fleet_timeline(path: str) -> str:
     return "\n".join(lines)
 
 
+def render_coverage(merged: dict) -> str:
+    """The gap list (the torn-flight satellite): which ranks the merge
+    HAS, which it expected but could not read — rendered, never raised."""
+    cov = merged["coverage"]
+    lines = ["## Merged timeline", "",
+             f"- **span events**: {len(merged['events'])} "
+             f"(+{len(merged['markers'])} journal markers)",
+             f"- **ranks present**: {cov['ranks_present'] or 'none'}"]
+    if cov["ranks_missing"]:
+        lines.append(f"- **ranks MISSING** (expected from the journal / "
+                     f"flight names, nothing readable): "
+                     f"{cov['ranks_missing']}")
+    for path, err in sorted(cov["unreadable"].items()):
+        lines.append(f"- **unreadable**: `{os.path.basename(path)}` — "
+                     f"{err}")
+    if cov["torn_lines"]:
+        lines.append(f"- **torn JSONL lines skipped**: "
+                     f"{cov['torn_lines']}")
+    if cov["uncalibrated_events"]:
+        lines.append(f"- **events without a wall stamp** (pre-round-10 "
+                     f"writer, no calibratable sibling): "
+                     f"{cov['uncalibrated_events']}")
+    return "\n".join(lines)
+
+
+def render_anatomy(rows: list[dict]) -> str:
+    """Per-step anatomy (obs/timeline.step_anatomy): where each logged
+    window's wall time went, per rank/attempt."""
+    if not rows:
+        return ""
+    lines = ["## Step anatomy (per logged window)", ""]
+    table_rows = []
+    for r in rows:
+        table_rows.append([
+            r.get("rank", ""), r.get("attempt", ""),
+            (f"{r['step_from']}..{r['step_to']}"
+             if r.get("step_from") is not None else r.get("step_to", "")),
+            r.get("n", ""), _fmt_num(r.get("window_s", "")),
+            _fmt_num(r.get("input_s") if r.get("input_s") is not None
+                     else ""),
+            _fmt_num(r.get("compute_s") if r.get("compute_s") is not None
+                     else ""),
+            _fmt_num(r.get("hook_s") if r.get("hook_s") is not None
+                     else ""),
+            _fmt_num(r.get("snapshot_s", "")),
+            _fmt_num(r.get("other_s") if r.get("other_s") is not None
+                     else ""),
+            _fmt_num(r.get("collective_ops") or ""),
+            _fmt_num(r.get("collective_bytes") or "")])
+    lines += _table(["rank", "att", "steps", "n", "window_s", "input_s",
+                     "compute_s", "hook_s", "snap_s", "other_s",
+                     "coll_ops", "coll_bytes"], table_rows)
+    tot = obs_timeline.anatomy_totals(rows)
+    lines += ["", "- **totals**: " + ", ".join(
+        f"{k}={_fmt_num(v)}" for k, v in sorted(tot.items()))]
+    return "\n".join(lines)
+
+
+def render_health(payloads: list[dict]) -> str:
+    """Health section: fleet aggregates first (stragglers + why), then
+    per-rank detector flags that fired."""
+    if not payloads:
+        return ""
+    lines = ["## Health", ""]
+    for h in sorted(payloads, key=lambda p: (p.get("kind") != "fleet",
+                                             p.get("rank") or 0)):
+        src = h.get("src", "")
+        if h.get("kind") == "fleet":
+            skew = h.get("skew") or {}
+            lines.append(f"- **fleet** (`{src}`): stragglers "
+                         f"{h.get('stragglers') or 'none'}, max step "
+                         f"{skew.get('max_step')}, lag {skew.get('lag_steps')}")
+            for r, why in sorted((skew.get("why") or {}).items()):
+                lines.append(f"  - rank {r}: {why}")
+        else:
+            fired = {k: f for k, f in (h.get("flags") or {}).items()
+                     if f.get("firing") or f.get("fired_step") is not None}
+            lines.append(
+                f"- **rank {h.get('rank')}** (`{src}`): step "
+                f"{h.get('step')}, "
+                + (", ".join(f"{k} fired@{f.get('fired_step')}"
+                             for k, f in sorted(fired.items()))
+                   or "no flags"))
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("flights", nargs="*",
@@ -228,28 +336,71 @@ def main(argv: list[str] | None = None) -> int:
                         "directory (OBS_DIR of the run)")
     p.add_argument("--journal", default="",
                    help="supervisor JSONL journal to render alongside")
+    p.add_argument("--format", default="md",
+                   choices=["md", "json", "trace"],
+                   help="md: OUTAGE-style markdown (default); trace: "
+                        "Perfetto/Chrome-trace JSON of the cross-rank "
+                        "merge; json: the raw merge + anatomy rows")
+    p.add_argument("--trace_glob", default="",
+                   help="glob of OBS_TRACE_FILE JSONLs to merge in "
+                        "(higher-fidelity than the flights' bounded "
+                        "span rings)")
+    p.add_argument("--health", action="append", default=[],
+                   help="extra health.json files to merge (those next "
+                        "to --dir/--journal are discovered)")
     p.add_argument("--max_spans", type=int, default=12)
     p.add_argument("--max_loss", type=int, default=8)
     args = p.parse_args(argv)
 
-    paths = list(args.flights)
-    if args.dir:
-        paths += sorted(glob.glob(os.path.join(args.dir, "flight_*.json")))
-    if not paths and not args.journal:
-        p.error("nothing to render: pass flight files, --dir, or --journal")
+    sources = obs_timeline.fleet_dir_sources(
+        flight_dir=args.dir, journal=args.journal,
+        trace_glob=args.trace_glob)
+    sources["flight_paths"] = sorted(set(sources["flight_paths"])
+                                     | set(args.flights))
+    sources["health_paths"] = sorted(set(sources["health_paths"])
+                                     | set(args.health))
+    if not sources["flight_paths"] and not sources["health_paths"] \
+            and not args.journal and not args.trace_glob:
+        p.error("nothing to render: pass flight files, --dir, "
+                "--trace_glob, --health, or --journal")
+    merged = obs_timeline.merge(**sources)
+
+    if args.format == "trace":
+        json.dump(obs_timeline.chrome_trace(merged), sys.stdout)
+        print()
+        return 0
+    anatomy = obs_timeline.step_anatomy(merged)
+    if args.format == "json":
+        json.dump({"coverage": merged["coverage"],
+                   "events": merged["events"],
+                   "markers": merged["markers"],
+                   "health": merged["health"],
+                   "collectives": {str(k): v for k, v in
+                                   merged["collectives"].items()},
+                   "anatomy": anatomy,
+                   "anatomy_totals": obs_timeline.anatomy_totals(anatomy)},
+                  sys.stdout, default=str)
+        print()
+        return 0
 
     sections = ["# Telemetry report", ""]
-    for path in paths:
+    for path in sorted(sources["flight_paths"]):
         try:
             with open(path) as f:
                 flight = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             sections.append(f"## Flight — `{os.path.basename(path)}`\n\n"
-                            f"- unreadable: {e}")
+                            f"- unreadable: {e} (rendered the rest — "
+                            f"see Merged timeline for the gap list)")
             continue
         sections.append(render_flight(path, flight,
                                       max_spans=args.max_spans,
                                       max_loss=args.max_loss))
+    sections.append(render_coverage(merged))
+    for section in (render_anatomy(anatomy),
+                    render_health(merged["health"])):
+        if section:
+            sections.append(section)
     if args.journal:
         timeline = render_fleet_timeline(args.journal)
         if timeline:

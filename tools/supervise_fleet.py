@@ -33,7 +33,14 @@ receives a fleet.prom textfile-collector export after every gang
 attempt; per-rank flight files land in OBS_DIR (default
 <workdir>/flight) as flight_<rank>_<pid>.json — render with
 ``python tools/obs_report.py --dir <workdir>/flight --journal
-<workdir>/fleet.jsonl``.
+<workdir>/fleet.jsonl`` (add ``--format trace > fleet.trace.json`` for
+a Perfetto-loadable cross-rank timeline).
+
+Online health (detection only): every rank gets OBS_HEALTH exported, so
+its AnomalyHook writes <workdir>/health_rank<r>.json; the fleet's
+monitor loop reads those, flags stragglers/skew
+(obs/anomaly.detect_skew), annotates the journal with ``anomaly``
+events, and maintains the aggregate <workdir>/health.json.
 """
 
 from __future__ import annotations
@@ -100,6 +107,18 @@ def main(argv: list[str] | None = None) -> int:
                         "state, where restarting with fewer workers is "
                         "structurally illegal")
     p.add_argument("--name", default="", help="task name for the journal")
+    p.add_argument("--health", default="",
+                   help="aggregate fleet health.json path (default "
+                        "<workdir>/health.json; 'none' disables the "
+                        "aggregate write — per-rank health_rank<r>.json "
+                        "files land in the workdir either way)")
+    p.add_argument("--skew_lag_steps", type=int, default=3,
+                   help="step lag behind the front rank before a rank "
+                        "counts as lagging")
+    p.add_argument("--skew_time_ratio", type=float, default=4.0,
+                   help="step-time multiple of the other ranks' median "
+                        "that marks a laggard as a straggler (its own "
+                        "regression flag also qualifies)")
     p.add_argument("--seed", type=int, default=None,
                    help="backoff-jitter seed (tests)")
     args = p.parse_args(argv)
@@ -132,7 +151,10 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed,
         elastic=args.elastic,
         worker_tiled=(args.sync_mode == "async"),
-        workdir=workdir)
+        workdir=workdir,
+        health_path=("" if args.health == "none" else args.health or None),
+        skew_lag_steps=args.skew_lag_steps,
+        skew_time_ratio=args.skew_time_ratio)
     try:
         res = fleet.run(child, name=args.name,
                         snapshot_dir_template=snapshots,
