@@ -1,7 +1,8 @@
 from distributedtensorflowexample_tpu.data.mnist import load_mnist
 from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
+from distributedtensorflowexample_tpu.data.lm import load_lm
 from distributedtensorflowexample_tpu.data.device_dataset import DeviceDataset
 from distributedtensorflowexample_tpu.data.pipeline import Batcher, DevicePrefetcher
 
-__all__ = ["load_mnist", "load_cifar10", "Batcher", "DevicePrefetcher",
-           "DeviceDataset"]
+__all__ = ["load_mnist", "load_cifar10", "load_lm", "Batcher",
+           "DevicePrefetcher", "DeviceDataset"]

@@ -258,7 +258,8 @@ class DeviceDataset:
                  shuffle: bool = True, start_step: int = 0,
                  steps_per_next: int = 1, quantize: str = "auto",
                  dequant_impl: str = "auto",
-                 data_sharding: str = "replicated"):
+                 data_sharding: str = "replicated",
+                 token_data: bool = False):
         """``steps_per_next``: global steps consumed per ``next()`` — set to
         the train step's ``unroll_steps`` so the perm ring is refreshed on
         the right call.  Any value >= 1 works; the ring is sized to hold
@@ -302,7 +303,19 @@ class DeviceDataset:
         semantics become per-shard (the reference's per-worker dataset
         sharding under MultiWorkerMirroredStrategy) rather than global;
         rows beyond ``mesh_size * (n // mesh_size)`` are dropped.  Pass
-        the SAME mode to the step factory."""
+        the SAME mode to the step factory.
+
+        ``token_data=True`` marks an INTEGER split (transformer-LM
+        tokens): no dequantization ever runs — the per-step gather
+        yields raw token ids and the model upcasts.  ``quantize`` then
+        selects the storage width instead of a dequant pipeline: any
+        non-"off" mode stores ids that fit a byte as uint8 (4x less
+        resident HBM + gather traffic than int32 — the quantized data
+        path's win applied to tokens); "off" keeps/restores int32.  The
+        yielded pytree carries a ``"tokens"`` marker leaf so the
+        gather's dequant dispatch (static on pytree structure, like the
+        dq_scale/lut keys) passes the batch through instead of refusing
+        the uint8-without-constants shape."""
         if quantize not in ("auto", "off", "exact", "scale"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.quantize = quantize
@@ -311,8 +324,25 @@ class DeviceDataset:
         if data_sharding == "sharded" and mesh is None:
             raise ValueError("data_sharding='sharded' requires a mesh")
         self.data_sharding = data_sharding
+        self.token_data = bool(token_data)
         self.dequant: str | None = None
-        if images.dtype == np.uint8:
+        if token_data:
+            images = np.asarray(images)
+            if not np.issubdtype(images.dtype, np.integer):
+                raise ValueError(
+                    f"token_data=True expects an integer token split, got "
+                    f"{images.dtype} (float pipelines are the image path)")
+            if quantize == "off":
+                if images.dtype != np.int32:
+                    images = images.astype(np.int32)
+            elif images.dtype != np.uint8:
+                if images.size and (images.min() < 0 or images.max() > 255):
+                    raise ValueError(
+                        "token ids exceed uint8 range; store them int32 "
+                        "with quantize='off' (a silent wrap would corrupt "
+                        "every out-of-byte id)")
+                images = images.astype(np.uint8)
+        elif images.dtype == np.uint8:
             # Raw bytes: downstream floats are u * (1/255) by convention.
             self.dequant = "unit"
         elif quantize != "off":
@@ -402,6 +432,12 @@ class DeviceDataset:
             self._affine = (put(s), put(b))
         elif self.dequant_impl is not None:
             self._lut = put(make_dequant_lut(self.dequant))
+        # Token splits: a replicated scalar whose PRESENCE in the pytree
+        # (not its value) tells the gather this uint8 batch is ids, not
+        # quantized pixels — the same static-structure dispatch the
+        # dq_scale/lut keys use.
+        self._tokens_marker = (put(np.zeros((), np.int32))
+                               if self.token_data else None)
 
         base = jax.random.PRNGKey(seed)
 
@@ -474,6 +510,8 @@ class DeviceDataset:
             data["lut"] = self._lut
         if self._affine is not None:
             data["dq_scale"], data["dq_bias"] = self._affine
+        if self._tokens_marker is not None:
+            data["tokens"] = self._tokens_marker
         return data
 
     def __next__(self):

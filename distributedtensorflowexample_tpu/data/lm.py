@@ -1,0 +1,85 @@
+"""Token data for the transformer-LM workload (models/transformer_lm.py).
+
+No real corpus ships with this image (the same no-network constraint as
+MNIST/CIFAR), so the ``lm`` dataset IS a deterministic synthetic corpus —
+a seeded order-1 Markov chain over ``LM_VOCAB`` tokens with peaked
+transitions: from token ``t`` the next token is ``perm[t]`` with
+probability ``1 - noise``, else uniform.  That gives the split real,
+learnable structure (a single attention layer reaches the ~1.0-nat
+bigram floor from the ~5.5-nat uniform start) while every byte stays
+reproducible from ``(seed, sample_seed)`` — the same learnable-synthetic
+discipline as ``data.synthetic.make_synthetic``.
+
+Storage follows the quantized-data-path convention: the model inputs are
+returned as **uint8** (``LM_VOCAB`` < 256 by design), so
+``DeviceDataset(token_data=True)`` holds the resident split at 1 byte
+per token — 4x less HBM and per-step gather traffic than int32 — and
+the model upcasts after the gather.  Targets stay int32 (the loss-side
+label convention).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributedtensorflowexample_tpu.models.transformer_lm import LM_VOCAB
+
+#: Sequence length of the shipped splits: inputs/targets are [N, SEQ_LEN]
+#: (each raw sequence is SEQ_LEN+1 tokens; targets are the 1-shifted view).
+LM_SEQ_LEN = 128
+#: How peaked the Markov transitions are (fraction following perm[t]).
+LM_FOLLOW = 0.85
+_SYNTH_SIZES = {"train": 2048, "test": 512}
+
+
+def make_synthetic_tokens(num: int, seq_len: int, vocab: int, seed: int,
+                          sample_seed: int | None = None,
+                          follow: float = LM_FOLLOW) -> np.ndarray:
+    """[num, seq_len + 1] int32 token sequences from the seeded Markov
+    chain.  ``seed`` fixes the transition structure (the learnable part);
+    splits that must generalize to each other share ``seed`` and differ
+    in ``sample_seed`` — the ``make_synthetic`` contract."""
+    rng = np.random.RandomState(seed)
+    pref = rng.permutation(vocab).astype(np.int32)
+    srng = np.random.RandomState(seed if sample_seed is None else sample_seed)
+    seq = np.empty((num, seq_len + 1), np.int32)
+    seq[:, 0] = srng.randint(0, vocab, size=num)
+    for t in range(1, seq_len + 1):
+        follows = srng.rand(num) < follow
+        rand_tok = srng.randint(0, vocab, size=num).astype(np.int32)
+        seq[:, t] = np.where(follows, pref[seq[:, t - 1]], rand_tok)
+    return seq
+
+
+def load_lm(data_dir: str, split: str, seed: int = 0,
+            source: str = "real", num: int | None = None,
+            seq_len: int = LM_SEQ_LEN,
+            vocab: int = LM_VOCAB) -> tuple[np.ndarray, np.ndarray]:
+    """(inputs uint8 [N, seq_len], targets int32 [N, seq_len]).
+
+    ``source`` mirrors the image loaders' contract for signature parity,
+    but every source resolves to the deterministic synthetic corpus:
+    unlike MNIST (where real bytes may be mounted and a silent synthetic
+    substitution would mislabel accuracies), there is no real-corpus
+    format this loader knows — the synthetic chain IS the dataset's
+    definition, so no fallback warning fires.  ``data_dir`` is accepted
+    (and ignored) for the same parity reason."""
+    del data_dir
+    if source not in ("real", "synthetic", "fallback"):
+        raise ValueError(f"unknown source {source!r}")
+    if num is None:
+        try:
+            num = _SYNTH_SIZES[split]
+        except KeyError:
+            raise ValueError(f"unknown split {split!r} (one of "
+                             f"{sorted(_SYNTH_SIZES)})") from None
+    # Train/test share the chain (seed) and differ in which sequences are
+    # drawn (sample_seed), so test perplexity measures generalization to
+    # unseen walks of the SAME structure.
+    sample_seed = seed + {"train": 1, "test": 2}.get(split, 3)
+    seq = make_synthetic_tokens(num, seq_len, vocab, seed,
+                                sample_seed=sample_seed)
+    if vocab > 256:
+        return seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    return (np.ascontiguousarray(seq[:, :-1]).astype(np.uint8),
+            np.ascontiguousarray(seq[:, 1:]).astype(np.int32))

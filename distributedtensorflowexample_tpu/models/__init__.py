@@ -1,8 +1,21 @@
 from distributedtensorflowexample_tpu.models.softmax import SoftmaxRegression
 from distributedtensorflowexample_tpu.models.mnist_cnn import MnistCNN
 from distributedtensorflowexample_tpu.models.resnet import ResNet20, ResNetCIFAR
+from distributedtensorflowexample_tpu.models.transformer_lm import (
+    LM_SIZES, LM_VOCAB, TransformerLM, build_lm)
 
 import jax.numpy as jnp
+
+
+def _lm_entry(size):
+    # Dropout defaults to 0.0 for the LM family (trainer_lm overrides the
+    # RunConfig 0.5 CNN default); remat/dtype knobs flow through like
+    # ResNet's.
+    return lambda **kw: build_lm(size,
+                                 dropout=kw.get("dropout", 0.0),
+                                 dtype=kw.get("dtype", jnp.bfloat16),
+                                 remat=kw.get("remat", "none"))
+
 
 _REGISTRY = {
     "softmax": lambda **kw: SoftmaxRegression(num_classes=10),
@@ -12,6 +25,7 @@ _REGISTRY = {
     "resnet20": lambda **kw: ResNet20(num_classes=10,
                                       dtype=kw.get("dtype", jnp.bfloat16),
                                       remat=kw.get("remat", "none")),
+    **{size: _lm_entry(size) for size in LM_SIZES},
 }
 
 
@@ -23,4 +37,6 @@ def build_model(name: str, **kw):
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}") from None
 
 
-__all__ = ["SoftmaxRegression", "MnistCNN", "ResNet20", "ResNetCIFAR", "build_model"]
+__all__ = ["SoftmaxRegression", "MnistCNN", "ResNet20", "ResNetCIFAR",
+           "TransformerLM", "build_lm", "LM_SIZES", "LM_VOCAB",
+           "build_model"]

@@ -319,7 +319,9 @@ def build_bucketed_step_fn(label_smoothing: float, ce_impl: str, mesh,
             # One fused psum pair for both scalar metrics (async-step
             # idiom) instead of GSPMD's two standalone scalar all-reduces.
             loss, correct = jax.lax.psum((loss_part, correct), DATA_AXIS)
-            return new_params, new_opt, loss, correct / global_b
+            # Accuracy normalizes per label ELEMENT (tokens for a [b, T]
+            # LM shard; == global_b for [b] image labels).
+            return new_params, new_opt, loss, correct / (lab.size * D)
 
         body_m = shard_map(
             body, mesh=mesh,

@@ -27,10 +27,29 @@ from distributedtensorflowexample_tpu.ops.losses import accuracy
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 
+def _per_example_rows(impl: Callable) -> Callable:
+    """Adapt a [rows, C] loss kernel to ALSO accept sequence logits
+    [B, T, C] / labels [B, T] (the transformer-LM head): tokens flatten
+    into rows — row-major, so a batch-axis sharding of B carries over to
+    B*T contiguously — and fold back to ONE per-EXAMPLE value (mean over
+    T).  Returning [B] keeps every downstream consumer (batch mean,
+    partial aggregation's per-replica row weights, the bucketed step's
+    sum/global_batch) shape-identical to the image models'."""
+    def rows(logits, labels):
+        if logits.ndim == 3:
+            b = logits.shape[0]
+            r = impl(logits.reshape(-1, logits.shape[-1]),
+                     labels.reshape(-1))
+            return jnp.mean(r.reshape(b, -1), axis=1)
+        return impl(logits, labels)
+    return rows
+
+
 def make_loss_rows(label_smoothing: float = 0.0, ce_impl: str = "xla",
                    mesh=None) -> Callable:
-    """Per-example loss head [B,C] -> [B], shared by the sync and async
-    step builders.
+    """Per-example loss head [B,C] -> [B] (or [B,T,C]/[B,T] -> [B] for
+    sequence models — see :func:`_per_example_rows`), shared by the sync
+    and async step builders.
 
     ``ce_impl="pallas"`` uses the fused Pallas kernel.  A ``pallas_call``
     is a custom call XLA cannot auto-partition, so on a multi-device mesh
@@ -43,11 +62,16 @@ def make_loss_rows(label_smoothing: float = 0.0, ce_impl: str = "xla",
     if ce_impl == "xla":
         from distributedtensorflowexample_tpu.ops.losses import (
             softmax_cross_entropy_rows)
-        return lambda l, y: softmax_cross_entropy_rows(l, y, label_smoothing)
+        return _per_example_rows(
+            lambda l, y: softmax_cross_entropy_rows(l, y, label_smoothing))
     from distributedtensorflowexample_tpu.ops.pallas import (
         fused_softmax_cross_entropy_rows)
-    fused = lambda l, y: fused_softmax_cross_entropy_rows(l, y,
-                                                          label_smoothing)
+    # The token-flatten adapter sits INSIDE the shard_map: the kernel
+    # sees its shard's [local_b * T, C] rows, reductions over T stay
+    # per-example and local.
+    fused = _per_example_rows(
+        lambda l, y: fused_softmax_cross_entropy_rows(l, y,
+                                                      label_smoothing))
     if mesh is not None and mesh.size > 1:
         from jax.sharding import PartitionSpec as P
         from distributedtensorflowexample_tpu.compat import shard_map
@@ -85,6 +109,12 @@ def _dequant_gathered(img, data, dequant_impl: str):
     known-slow elementwise gather diagnostic) and catches a
     factory/dataset mismatch as a trace-time error instead of a wrong
     kernel."""
+    if "tokens" in data:
+        # Token split (DeviceDataset token_data=True): the uint8 batch
+        # is ids, not quantized pixels — the model upcasts after the
+        # gather.  Static dispatch on pytree structure, like the
+        # dq_scale/lut families.
+        return img
     if img.dtype != jnp.uint8:
         return img
     from distributedtensorflowexample_tpu.data.device_dataset import (
@@ -248,6 +278,7 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
     def gather(step, rng, data):
         has_lut = "lut" in data
         has_affine = "dq_scale" in data
+        has_tokens = "tokens" in data
 
         def local(step, rng, images, labels, perm, *dq):
             d = jax.lax.axis_index(DATA_AXIS)
@@ -282,7 +313,8 @@ def _make_sharded_gather(batch_size: int, steps_per_epoch: int,
                     from distributedtensorflowexample_tpu.data.augment_device import (
                         cifar_augment_device)
                     img = cifar_augment_device(img, akey)
-            img = _dequant_gathered(img, dq_data, dequant_impl)
+            if not has_tokens:          # token ids pass through raw
+                img = _dequant_gathered(img, dq_data, dequant_impl)
             return img, jnp.take(labels, idx, axis=0)
 
         args = [step, rng, data["images"], data["labels"], data["perm"]]
@@ -545,7 +577,8 @@ def make_eval_step() -> Callable:
 
 def make_resident_eval(images, labels, batch_size: int = 1000,
                        mesh=None, quantize: str = "auto",
-                       dequant_impl: str = "auto") -> Callable:
+                       dequant_impl: str = "auto",
+                       token_data: bool = False) -> Callable:
     """Device-resident exact-accuracy eval: ONE dispatch per eval.
 
     The host-fed ``evaluate`` re-uploads the split 1000 rows at a time on
@@ -563,6 +596,11 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     (``pallas`` degenerates to affine here: the scan slices resident
     batches, there is no row gather to fuse).
 
+    ``token_data=True`` (the LM family): the split is integer ids — no
+    dequant machinery runs, the model upcasts, and accuracy normalizes
+    per LABEL ELEMENT (per token for [N, T] targets; identical to the
+    per-example count for [N] image labels).
+
     Returns ``eval_fn(state) -> float`` (exact accuracy over the split).
     """
     import numpy as np
@@ -573,7 +611,7 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     if quantize not in ("auto", "off", "exact", "scale"):
         raise ValueError(f"unknown quantize mode {quantize!r}")
     dequant = None
-    if quantize != "off":
+    if not token_data and quantize != "off":
         q = _try_quantize(np.asarray(images))
         if q is not None:
             images, dequant = q
@@ -582,6 +620,10 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     impl = "affine" if impl == "pallas" else impl
 
     n = len(labels)
+    # Accuracy denominator: label ELEMENTS of the real split (tokens for
+    # a [N, T] LM split; == n for [N] image labels).  Pad labels are -1
+    # and never match an argmax, so only the denominator needs care.
+    denom = int(np.asarray(labels).size)
     if mesh is not None and batch_size % mesh.size:
         raise ValueError(f"eval batch {batch_size} must divide across "
                          f"{mesh.size} devices")
@@ -590,10 +632,12 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
     if pad:
         images = np.concatenate(
             [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
-        labels = np.concatenate([labels, np.full((pad,), -1, labels.dtype)])
+        labels = np.concatenate(
+            [labels, np.full((pad,) + labels.shape[1:], -1, labels.dtype)])
     xs = np.ascontiguousarray(
         images.reshape((num_batches, batch_size) + images.shape[1:]))
-    ys = np.ascontiguousarray(labels.reshape(num_batches, batch_size))
+    ys = np.ascontiguousarray(
+        labels.reshape((num_batches, batch_size) + labels.shape[1:]))
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -630,7 +674,7 @@ def make_resident_eval(images, labels, batch_size: int = 1000,
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32), (xs, ys))
         return total
 
-    return lambda state: int(run(state, xs, ys)) / n
+    return lambda state: int(run(state, xs, ys)) / denom
 
 
 def evaluate(state: TrainState, images, labels, batch_size: int = 1000,

@@ -19,9 +19,15 @@ kind                models
                     before the poisoned state can be snapshotted);
                     refused loudly on uint8 batches — no NaN byte exists
                     (use ``corrupt_batch`` there)
-``corrupt_batch``   a corrupted uint8 batch off the wire: deterministic
-                    garbage bytes for uint8 images, non-finite-driving
-                    magnitudes for float images
+``corrupt_batch``   a corrupted batch off the wire: deterministic
+                    garbage bytes for uint8 images, wide garbage ids
+                    for integer token batches (out-of-vocab by
+                    construction — the LM's OOV poison turns them into
+                    the NaN the guard fails fast on), non-finite-
+                    driving magnitudes for float images.  Rank-
+                    targeted (``corrupt_batch@N%RANK`` or the named
+                    ``corrupt_batch_rank`` plan) it is the one-bad-
+                    host ingest scenario for gang drills
 ``torn_snapshot``   a checkpoint write torn mid-file — applied to the
                     newest snapshot AFTER the final save (see
                     tools/faultline.py), so recovery must fall back to
@@ -114,13 +120,22 @@ FLAP_EDGE_MARGIN_S = 0.05
 # Named plans: the scenario library tools/faultline.py exposes.  A None
 # step is drawn deterministically from the plan seed (one shared anchor
 # per plan, so e.g. torn_snapshot+preemption land at the SAME step — the
-# "final write torn" shape).
+# "final write torn" shape).  Entries are (kind, step, arg) or
+# (kind, step, arg, rank) — a 4-tuple pins the spec to one rank, the
+# grammar's %RANK suffix as a named scenario.
 NAMED_PLANS = {
     "none": [],
     "preempt": [("preemption", None, 0.0)],
     "wedge": [("wedge", None, 2.0)],
     "nan_loss": [("nan_loss", None, 0.0)],
     "corrupt_batch": [("corrupt_batch", None, 0.0)],
+    # Rank-targeted corruption (the ROADMAP round-8 candidate): ONE
+    # rank's batch goes bad off the wire — on a token pipeline the LM's
+    # OOV poison NaNs that rank's loss, NaNGuard kills it, and the gang
+    # supervisor must tear down + agree a resume step while the healthy
+    # ranks were mid-stride.  Rank 1 by convention (the 2-rank drills'
+    # non-chief rank); pin others with corrupt_batch@N%RANK.
+    "corrupt_batch_rank": [("corrupt_batch", None, 0.0, 1)],
     "torn_snapshot": [("torn_snapshot", None, 0.0),
                       ("preemption", None, 0.0)],
     # arg 0.0: the flap delay defaults to the supervisor-exported
@@ -202,9 +217,11 @@ class FaultPlan:
         specs: list[FaultSpec] = []
         for token in filter(None, (t.strip() for t in text.split(","))):
             if token in NAMED_PLANS:
-                for kind, step, arg in NAMED_PLANS[token]:
+                for entry in NAMED_PLANS[token]:
+                    kind, step, arg = entry[:3]
+                    rank = entry[3] if len(entry) > 3 else None
                     specs.append(FaultSpec(kind, anchor if step is None
-                                           else step, arg))
+                                           else step, arg, rank=rank))
                 continue
             body, _, ranktxt = token.partition("%")
             body, _, argtxt = body.partition(":")
@@ -386,20 +403,33 @@ class FaultyBatches:
         img = np.asarray(batch["image"])
         if kind == "nan_loss":
             # The kind check comes FIRST: a nan_loss that silently
-            # degraded to legal random bytes on a uint8 pipeline would
-            # make the NaN-guard drill pass vacuously — the guard never
-            # fires, yet the scenario reports success.
-            if img.dtype == np.uint8:
+            # degraded to legal random values on an integer pipeline
+            # would make the NaN-guard drill pass vacuously — the guard
+            # never fires, yet the scenario reports success.  (np.full
+            # with NaN into an int dtype would not even produce a legal
+            # batch — it raises or wraps to garbage silently.)
+            if np.issubdtype(img.dtype, np.integer):
                 raise ValueError(
-                    "nan_loss cannot be represented in a uint8 batch "
-                    "(no NaN byte exists); use corrupt_batch for uint8 "
-                    "pipelines or inject on the float (host-fed) path")
+                    f"nan_loss cannot be represented in a {img.dtype} "
+                    f"batch (no NaN integer exists); use corrupt_batch "
+                    f"for uint8/token pipelines or inject on the float "
+                    f"(host-fed) path")
             bad = np.full(img.shape, np.nan, img.dtype)
         elif img.dtype == np.uint8:
             # A corrupted uint8 batch off the wire: every value is still
             # a legal byte, so only training dynamics (or a checksum
             # upstream) can notice — deterministic from the plan seed.
+            # On a TOKEN pipeline (vocab < 256 by design — transformer_
+            # lm.LM_VOCAB) random bytes land out-of-vocab and the LM's
+            # OOV poison turns them into the NaN the guard fails fast on.
             bad = self._rng.integers(0, 256, img.shape, dtype=np.uint8)
+        elif np.issubdtype(img.dtype, np.integer):
+            # Wide-integer token ids off the wire: garbage ids far
+            # outside any vocab — XLA gathers would CLAMP them silently,
+            # which is exactly why the LM poisons its logits instead
+            # (models/transformer_lm.py OOV guard).
+            bad = self._rng.integers(0, np.iinfo(np.int32).max,
+                                     img.shape).astype(img.dtype)
         else:
             # Finite but loss-exploding magnitudes: overflow to inf/nan
             # inside the forward pass, not in the input itself.

@@ -18,7 +18,8 @@ import numpy as np
 from distributedtensorflowexample_tpu import cluster
 from distributedtensorflowexample_tpu.config import RunConfig
 from distributedtensorflowexample_tpu.data import (
-    Batcher, DeviceDataset, DevicePrefetcher, load_cifar10, load_mnist)
+    Batcher, DeviceDataset, DevicePrefetcher, load_cifar10, load_lm,
+    load_mnist)
 from distributedtensorflowexample_tpu.data.cifar10 import augment as cifar_augment
 from distributedtensorflowexample_tpu.models import build_model
 from distributedtensorflowexample_tpu.parallel import (
@@ -95,6 +96,11 @@ def _load_dataset(cfg: RunConfig, name: str, split: str):
     if name == "cifar10":
         return load_cifar10(cfg.data_dir, split, seed=cfg.seed,
                             source=source)
+    if name == "lm":
+        # Token corpus for the transformer-LM family: both sources
+        # resolve to the deterministic synthetic chain (no real-corpus
+        # format exists yet — data/lm.py), so no fallback warning fires.
+        return load_lm(cfg.data_dir, split, seed=cfg.seed, source=source)
     raise ValueError(f"unknown dataset {name!r}")
 
 
@@ -230,6 +236,18 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # by name, not after (or instead of) a multi-second dataset read.
     if cfg.device_data not in ("auto", "on", "off"):
         raise ValueError(f"unknown device_data {cfg.device_data!r}")
+    # Token datasets (the transformer-LM family) are integer splits: the
+    # host Batcher/prefetch path is a float-image pipeline whose uint8
+    # convention means "quantized pixels" — dequantizing ids to floats
+    # would silently train on garbage, so the off-path is refused by
+    # name instead.
+    token_data = dataset_name == "lm"
+    if token_data and cfg.device_data == "off":
+        raise ValueError(
+            "the lm dataset is an integer token split and runs on the "
+            "device-resident input path only; --device_data off selects "
+            "the host float-image Batcher, which would dequantize token "
+            "ids into pixels. Drop --device_data off")
     if cfg.sync_mode not in ("sync", "async"):
         raise ValueError(f"unknown sync_mode {cfg.sync_mode!r}")
     if cfg.data_sharding not in ("replicated", "sharded"):
@@ -293,7 +311,10 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                         dtype=jnp.dtype(cfg.dtype), remat=cfg.remat)
     tx = build_optimizer(cfg, mesh=mesh,
                          wrap_shard_update=not bucket_zero1)
-    sample_shape = (global_batch,) + _SAMPLE_SHAPES[dataset_name]
+    # Sample shape comes from the loaded split itself (images: [N,H,W,C],
+    # tokens: [N,T]) — _SAMPLE_SHAPES stays as documentation of the
+    # image families' shapes.
+    sample_shape = (global_batch,) + tuple(train_x.shape[1:])
     state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
     if bucket_bytes and cfg.sync_mode == "sync" and num_replicas > 1 \
             and state.batch_stats:
@@ -374,7 +395,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         # wall time stops polluting the training window.
         _evaluate = make_resident_eval(test_x, test_y, batch_size=eval_batch,
                                        mesh=mesh, quantize=cfg.quantize,
-                                       dequant_impl=cfg.dequant_impl)
+                                       dequant_impl=cfg.dequant_impl,
+                                       token_data=token_data)
     else:
         _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
                                       batch_size=eval_batch,
@@ -427,7 +449,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                            steps_per_next=steps_per_call,
                            quantize=cfg.quantize,
                            dequant_impl=cfg.dequant_impl,
-                           data_sharding=cfg.data_sharding)
+                           data_sharding=cfg.data_sharding,
+                           token_data=token_data)
         batches = ds
     elif cfg.steps_per_loop > 1:
         raise ValueError("--steps_per_loop > 1 requires the "

@@ -354,6 +354,201 @@ def bytes_audit(hlo_text: str, unroll: int = 1, top_k: int = 12) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Dot-general / convolution FLOP accounting (the MFU denominator).
+#
+# The bytes audit prices memory traffic; nothing priced the ARITHMETIC —
+# the aggregate ``cost_analysis()["flops"]`` lumps matmul flops together
+# with elementwise/softmax/reduce noise, so an MFU number derived from it
+# over-counts the numerator's useful work and can drift silently with
+# any elementwise refactor.  The optimized HLO has what is needed to
+# price the MXU work exactly: every ``dot`` line prints its output shape,
+# operand shapes, AND ``lhs_contracting_dims`` inline — including the
+# batched dot-generals attention einsums lower to — so
+#
+#     dot flops = 2 * prod(output dims) * prod(contracting dims)
+#
+# covers plain matmuls, batch-dim matmuls ([B,H,T,Dh] x [B,H,Dh,S]) and
+# the vocab head identically (2 flops per MAC, HloCostAnalysis's own
+# convention — golden-pinned in tests).  Convolutions price as
+# 2 * out_elems * kernel_elems / out_channels (the per-output-element
+# MAC count; feature groups cancel out of that ratio).  Dots fused into
+# a fusion are priced from the fused computation at the fusion's weight.
+# NOT covered: backend custom-calls (e.g. oneDNN conv rewrites) — absent
+# from the programs the goldens pin; a custom-call carries no dim
+# metadata to price.
+
+_DOT_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+_CONV_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
+
+
+def _first_shape_dims(token: str) -> list[int]:
+    """Dims of the FIRST ``dtype[d0,...]`` shape in *token*."""
+    m = _SHAPE_RE.search(token)
+    if not m:
+        return []
+    return [int(d) for d in m.group(2).split(",") if d]
+
+
+def _prod(dims) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def _instr_flops(opcode: str, out_tok: str, line: str,
+                 args_at: int) -> int | None:
+    """FLOPs of one dot/convolution instruction line (None = not one)."""
+    if opcode == "dot":
+        m = _DOT_LHS_CONTRACT_RE.search(line)
+        if not m:
+            return None
+        operands = _operand_token(line, args_at)
+        lhs = _first_shape_dims(operands)
+        contract = [int(d) for d in m.group(1).split(",") if d]
+        k = _prod(lhs[i] for i in contract if i < len(lhs))
+        return 2 * _prod(_first_shape_dims(out_tok)) * k
+    if opcode == "convolution":
+        mm = _CONV_DIM_LABELS_RE.search(line)
+        if not mm:
+            return None
+        out_dims = _first_shape_dims(out_tok)
+        out_labels = mm.group(3)
+        f_pos = out_labels.find("f")
+        if f_pos < 0 or f_pos >= len(out_dims):
+            return None
+        operands = _operand_token(line, args_at)
+        shapes = [[int(d) for d in s.split(",") if d]
+                  for _, s in _SHAPE_RE.findall(operands)]
+        if len(shapes) < 2:
+            return None
+        kernel_elems = _prod(shapes[1])
+        out_ch = max(1, out_dims[f_pos])
+        return 2 * _prod(out_dims) * kernel_elems // out_ch
+    return None
+
+
+def hlo_flops_by_op(hlo_text: str, unroll: int = 1) -> list:
+    """Per-instruction dot/convolution FLOP rows from optimized HLO text
+    (weighted like :func:`hlo_bytes_by_op`: control flow walked from
+    ENTRY, scan bodies by trip count; dots INSIDE a fusion priced from
+    the fused computation at the fusion's weight)."""
+    comps, entry = _split_computations(hlo_text)
+    if entry is None:
+        return []
+    weights = _computation_weights(comps, entry, unroll)
+
+    def fused_rows(target: str, weight: int, via: str):
+        out = []
+        for name, out_tok, opcode, line, args_at in comps.get(target, ()):
+            fl = _instr_flops(opcode, out_tok, line, args_at)
+            if fl:
+                mm = _OPNAME_RE.search(line)
+                out.append({"flops": fl * weight, "opcode": opcode,
+                            "name": name, "fusion": via,
+                            "out": out_tok.strip()[:60],
+                            "op_name": mm.group(1) if mm else ""})
+        return out
+
+    rows = []
+    for comp, weight in weights.items():
+        for name, out_tok, opcode, line, args_at in comps.get(comp, ()):
+            if opcode == "fusion":
+                for kind, t in _CALLS_RE.findall(line):
+                    if kind == "calls":
+                        rows.extend(fused_rows(t, weight, name))
+                continue
+            fl = _instr_flops(opcode, out_tok, line, args_at)
+            if fl:
+                mm = _OPNAME_RE.search(line)
+                rows.append({"flops": fl * weight, "opcode": opcode,
+                             "name": name, "fusion": "",
+                             "out": out_tok.strip()[:60],
+                             "op_name": mm.group(1) if mm else ""})
+    rows.sort(key=lambda r: -r["flops"])
+    return rows
+
+
+def flops_audit(hlo_text: str, unroll: int = 1, top_k: int = 8) -> dict:
+    """Summarize :func:`hlo_flops_by_op` into the MFU-denominator record:
+    per-step dot/conv flops (``per_step`` divides by ``unroll``, the
+    bytes-audit convention) plus the ``top_k`` heaviest ops."""
+    rows = hlo_flops_by_op(hlo_text, unroll=unroll)
+    u = max(1, unroll)
+    dot = sum(r["flops"] for r in rows if r["opcode"] == "dot")
+    conv = sum(r["flops"] for r in rows if r["opcode"] == "convolution")
+    top = [{"flops_per_step": round(r["flops"] / u),
+            "opcode": r["opcode"], "op_name": r["op_name"][-80:],
+            "out": r["out"]} for r in rows[:top_k]]
+    return {
+        "matmul_flops_per_step": round(dot / u),
+        "conv_flops_per_step": round(conv / u),
+        "flops_per_step": round((dot + conv) / u),
+        "op_count_per_step": round(len(rows) / u, 4),
+        "top_ops": top,
+    }
+
+
+def compiled_program_audit(step, args, unroll: int = 1,
+                           top_k: int = 12) -> dict:
+    """ONE lower+compile serving every per-program instrument: the
+    aggregate cost keys (flops / bytes_accessed), the per-op bytes
+    audit, the dot/conv flops audit (the MFU denominator), the
+    collective inventory, and the compiler's own memory analysis
+    (``temp_bytes`` is the per-device temp/activation arena — the
+    peak-memory number the remat A/B measures).  Each section degrades
+    to ``{}`` independently, the shared contract of the single-purpose
+    helpers above."""
+    out = {"cost": {}, "bytes": {}, "flops": {}, "collectives": {},
+           "memory": {}}
+    try:
+        compiled = step.lower(*args).compile()
+    except Exception:
+        return out
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        for key, name in (("flops", "flops"),
+                          ("bytes accessed", "bytes_accessed")):
+            if key in ca:
+                out["cost"][name] = float(ca[key]) / max(1, unroll)
+    except Exception:
+        pass
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        txt = ""
+    if txt:
+        try:
+            out["bytes"] = bytes_audit(txt, unroll=unroll, top_k=top_k)
+        except Exception:
+            pass
+        try:
+            out["flops"] = flops_audit(txt, unroll=unroll)
+        except Exception:
+            pass
+        try:
+            out["collectives"] = collective_inventory(txt, unroll=unroll)
+        except Exception:
+            pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out["memory"] = {
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes),
+                "generated_code_bytes": int(
+                    ma.generated_code_size_in_bytes),
+            }
+    except Exception:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Per-collective accounting (the comms twin of the bytes audit).
 #
 # The bytes audit says WHICH ops carry the HBM traffic; nothing said which

@@ -192,6 +192,80 @@ ENTRY %main (a: f32[8,4]) -> f32[8,4] {
         sum(r["bytes"] for r in rows) / 4)
 
 
+def test_flops_parser_on_synthetic_text():
+    """Dot-general FLOP accounting, pure text: batch dims, contracting
+    dims, fusion-internal dots at the fusion's weight, scan weighting —
+    the golden pin for the MFU denominator (an attention einsum priced
+    wrong would silently drift every MFU line)."""
+    from distributedtensorflowexample_tpu.utils.profiling import (
+        flops_audit, hlo_flops_by_op)
+    hlo = """
+HloModule m
+
+%fused_dot (p0: f32[8,16]) -> f32[8,8] {
+  %p0 = f32[8,16]{1,0} parameter(0)
+  ROOT %fd = f32[8,8]{1,0} dot(f32[8,16]{1,0} %p0, f32[16,8]{1,0} %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+%body (p: s32[]) -> s32[] {
+  %p = s32[] parameter(0)
+  %bdot = f32[2,4,16,16]{3,2,1,0} dot(f32[2,4,16,8]{3,2,1,0} %p, f32[2,4,8,16]{3,2,1,0} %p), lhs_batch_dims={0,1}, lhs_contracting_dims={3}, rhs_batch_dims={0,1}, rhs_contracting_dims={2}
+  ROOT %c = s32[] add(s32[] %p, s32[] %p)
+}
+
+%cond (p: s32[]) -> pred[] {
+  %p = s32[] parameter(0)
+  ROOT %ok = pred[] compare(s32[] %p, s32[] %p), direction=LT
+}
+
+ENTRY %main (a: f32[4,4]) -> f32[4,4] {
+  %a = f32[4,4]{1,0} parameter(0)
+  %w = s32[] while(s32[] %a), condition=%cond, body=%body
+  %conv = f32[1,8,8,32]{3,2,1,0} convolution(f32[1,8,8,16]{3,2,1,0} %a, f32[3,3,16,32]{3,2,1,0} %a), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f
+  ROOT %f = f32[8,8]{1,0} fusion(f32[4,4]{1,0} %conv), kind=kLoop, calls=%fused_dot
+}
+"""
+    rows = hlo_flops_by_op(hlo, unroll=4)
+    by_name = {r["name"]: r for r in rows}
+    # Batched dot inside the scan body: 2 * prod(out) * K, x unroll 4.
+    assert by_name["bdot"]["flops"] == 2 * (2 * 4 * 16 * 16) * 8 * 4
+    # Fusion-internal dot priced at the fusion's weight (1).
+    assert by_name["fd"]["flops"] == 2 * (8 * 8) * 16
+    assert by_name["fd"]["fusion"] == "f"
+    # Convolution: 2 * out_elems * kh*kw*cin (kernel_elems / out_ch).
+    assert by_name["conv"]["flops"] == 2 * (8 * 8 * 32) * (3 * 3 * 16)
+    summary = flops_audit(hlo, unroll=4)
+    assert summary["matmul_flops_per_step"] == round(
+        (by_name["bdot"]["flops"] + by_name["fd"]["flops"]) / 4)
+    assert summary["conv_flops_per_step"] == round(
+        by_name["conv"]["flops"] / 4)
+    assert summary["flops_per_step"] == (summary["matmul_flops_per_step"]
+                                         + summary["conv_flops_per_step"])
+
+
+def test_flops_audit_matches_xla_on_attention_einsum():
+    """The compiled attention einsum's parsed flops equal both the
+    analytic count AND XLA's own cost analysis — dot-generals with batch
+    dims are priced exactly (the satellite fix: a batch dim mistaken for
+    a contracting dim would square T into the count)."""
+    from distributedtensorflowexample_tpu.utils.profiling import (
+        flops_audit)
+    B, T, H, Dh = 2, 16, 4, 8
+
+    def att(q, k):
+        return jnp.einsum("bthd,bshd->bhts", q, k)
+
+    compiled = jax.jit(att).lower(
+        jnp.zeros((B, T, H, Dh)), jnp.zeros((B, T, H, Dh))).compile()
+    fa = flops_audit(compiled.as_text())
+    assert fa["flops_per_step"] == 2 * B * H * T * T * Dh
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    if ca and ca.get("flops"):
+        assert fa["flops_per_step"] == int(ca["flops"])
+
+
 def test_remat_block_is_bitwise_identical():
     """--remat block replays identical ops: loss, grads AND the BN stat
     updates must match the un-remat'd model BITWISE (no tolerance — the

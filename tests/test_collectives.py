@@ -323,6 +323,49 @@ def test_bucketed_zero1_golden_inventory_and_bitwise_parity():
                  s_ref.params, s_z.params)
 
 
+@pytest.mark.lm
+def test_lm_golden_inventory():
+    """The transformer-LM trainer's golden multisets (the third trainer
+    family): 30 param leaves -> 30 per-parameter gradient all-reduces +
+    the 2 metric scalars on the GSPMD default; ONE knee-sized bucket +
+    the fused metrics pair under --bucket_grads (the whole lm_tiny tree
+    fits one bucket); the explicit per-bucket RS+AG pair + metrics under
+    the composed ZeRO-1 schedule.  BN-free by construction, so unlike
+    resnet20 every schedule is legal for this model."""
+    from distributedtensorflowexample_tpu.data.lm import load_lm
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        init_bucketed_opt_state)
+    mesh = make_mesh()
+    x, y = load_lm("", "train", num=128, seq_len=16, seed=0)
+    mk_tx = lambda: optax.sgd(0.1, momentum=0.9)
+    ds = DeviceDataset(x, y, 32, mesh=mesh, seed=0, token_data=True)
+    state = TrainState.create_sharded(
+        build_model("lm_tiny"), mk_tx(), (32, 16), 0,
+        replicated_sharding(mesh))
+    plain = make_indexed_train_step(32, ds.steps_per_epoch, mesh=mesh,
+                                    num_slots=ds.num_slots)
+    bkt = make_indexed_train_step(32, ds.steps_per_epoch, mesh=mesh,
+                                  num_slots=ds.num_slots,
+                                  bucket_bytes=DEFAULT_BUCKET_BYTES)
+    z1 = make_indexed_train_step(32, ds.steps_per_epoch, mesh=mesh,
+                                 num_slots=ds.num_slots,
+                                 bucket_bytes=DEFAULT_BUCKET_BYTES,
+                                 bucket_shard_update=True)
+    s_z = state.replace(opt_state=init_bucketed_opt_state(
+        mk_tx(), state.params, DEFAULT_BUCKET_BYTES, mesh))
+    with mesh:
+        inv_p = collective_inventory_of(plain, (state, ds.peek()))
+        inv_b = collective_inventory_of(bkt, (state, ds.peek()))
+        inv_z = collective_inventory_of(z1, (s_z, ds.peek()))
+    assert inv_p["multiset"] == {"all-reduce": 32}      # 30 grads + 2
+    assert inv_b["multiset"] == {"all-reduce": 3}       # 1 bucket + 2
+    assert inv_z["multiset"] == {"all-gather": 1, "all-reduce": 2,
+                                 "reduce-scatter": 1}
+    # Gradient bytes conserved by bucketing (metrics pair rides along).
+    assert inv_b["total_out_bytes_per_step"] >= \
+        inv_p["total_out_bytes_per_step"] - 16
+
+
 def test_bucket_size_invariance_and_fewer_ops_on_cnn():
     """mnist_cnn (8 grad leaves -> 8 per-parameter all-reduces + 2
     metric scalars on the default path): bucketing is bitwise ACROSS

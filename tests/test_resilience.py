@@ -561,7 +561,7 @@ def test_supervise_capture_queue_shape(monkeypatch, tmp_path):
     tasks = supervise._capture_tasks(start_ts=time.time() - 5)
     names = [t.name for t in sorted(tasks, key=lambda t: t.priority)]
     assert names == ["headline_bench", "profile", "bytes_audit_cpu",
-                     "collectives", "full_bench", "cli_trainer"]
+                     "collectives", "lm", "full_bench", "cli_trainer"]
     by_name = {t.name: t for t in tasks}
     assert by_name["headline_bench"].env["BENCH_HEADLINE_ONLY"] == "1"
     assert not by_name["bytes_audit_cpu"].needs_chip
@@ -569,6 +569,10 @@ def test_supervise_capture_queue_shape(monkeypatch, tmp_path):
     # the .tmp artifact, sentinel-capable so it can't wedge the queue
     assert "--real" in by_name["collectives"].argv
     assert by_name["collectives"].post is not None
+    # lm phase (2d): same --real/keep()/sentinel discipline as 2c
+    assert "--real" in by_name["lm"].argv
+    assert by_name["lm"].post is not None
+    assert "bench_lm.py" in " ".join(by_name["lm"].argv)
     assert by_name["cli_trainer"].wall_timeout_s > 0
     # gate: no fresh measured OUT -> phase 4 must not run
     assert by_name["cli_trainer"].gate() is False

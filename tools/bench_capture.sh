@@ -43,6 +43,7 @@ OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r05.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_auto_r05.json}
 BYTES_OUT=${BYTES_OUT:-BYTES_AUDIT_r05.json}
 COLLECTIVES_OUT=${COLLECTIVES_OUT:-BENCH_collectives_r06.json}
+LM_OUT=${LM_OUT:-BENCH_lm_r08.json}
 TRACE_TGZ=${TRACE_TGZ:-resnet_trace_r05.tgz}
 CLI_OUT=${CLI_OUT:-CLI_r05.log}
 TRACE_DIR=${TRACE_DIR:-/tmp/resnet_trace}
@@ -153,6 +154,15 @@ python bench_collectives.py --real --json "$COLLECTIVES_OUT.tmp" \
 rc2c=$?
 keep "$COLLECTIVES_OUT.tmp" "$COLLECTIVES_OUT"
 echo "collectives rc=$rc2c" >> "$LOG"
+
+# --- phase 2d: graft-LM family (bench_lm.py --real) -----------------------
+# tokens/sec + MFU + the lm_base knob A/B matrix on the live backend;
+# same sentinel/platform-labeling discipline as phase 2c.
+python bench_lm.py --real --json "$LM_OUT.tmp" \
+  >> "$LOG" 2>> "$LOG"
+rc2d=$?
+keep "$LM_OUT.tmp" "$LM_OUT"
+echo "lm rc=$rc2d" >> "$LOG"
 
 # --- phase 3: full bench --------------------------------------------------
 python bench.py > "$OUT.tmp" 2>> "$LOG"

@@ -60,18 +60,33 @@ def _digest(state) -> str:
     return h.hexdigest()
 
 
+_LM_DRILL_SEQ = 32      # short sequences keep LM drills tier-1-cheap
+
+
 def _batch_stream(batch_size: int, seed: int, start_step: int,
-                  pool_size: int = 4):
+                  pool_size: int = 4, model: str = "softmax"):
     """Deterministic, step-addressable batches: step s always sees pool
     slot (s-1) % pool_size, so a resumed run replays the identical
     stream from its restored step — the dataset-cursor contract the
-    snapshot manifest records (here the cursor IS the step)."""
+    snapshot manifest records (here the cursor IS the step).  LM models
+    get int32 token batches (the host-fed integer convention: uint8
+    would read as quantized pixels to the dequant seam)."""
     import jax.numpy as jnp
 
-    from distributedtensorflowexample_tpu.data.synthetic import (
-        make_synthetic)
-    x, y = make_synthetic(batch_size * pool_size, (28, 28, 1), 10,
-                          seed=seed + 1)
+    if model.startswith("lm_"):
+        from distributedtensorflowexample_tpu.data.lm import (
+            make_synthetic_tokens)
+        from distributedtensorflowexample_tpu.models.transformer_lm import (
+            LM_VOCAB)
+        seq = make_synthetic_tokens(batch_size * pool_size, _LM_DRILL_SEQ,
+                                    LM_VOCAB, seed, sample_seed=seed + 1)
+        x = seq[:, :-1].astype("int32")
+        y = seq[:, 1:].astype("int32")
+    else:
+        from distributedtensorflowexample_tpu.data.synthetic import (
+            make_synthetic)
+        x, y = make_synthetic(batch_size * pool_size, (28, 28, 1), 10,
+                              seed=seed + 1)
     pool = [{"image": jnp.asarray(x[i * batch_size:(i + 1) * batch_size]),
              "label": jnp.asarray(y[i * batch_size:(i + 1) * batch_size])}
             for i in range(pool_size)]
@@ -95,7 +110,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="snapshot directory (shared across attempts — "
                         "this is what resume resumes from)")
     p.add_argument("--model", default="softmax",
-                   choices=["softmax", "mnist_cnn"])
+                   choices=["softmax", "mnist_cnn", "lm_tiny"],
+                   help="lm_tiny drills the transformer-LM trainer: "
+                        "corrupt_batch garbage ids land out-of-vocab, "
+                        "the model's OOV poison NaNs the loss, and "
+                        "NaNGuard + the flight recorder take it from "
+                        "there (models/transformer_lm.py)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--snapshot_every", type=int, default=1)
@@ -166,9 +186,11 @@ def main(argv: list[str] | None = None) -> int:
     store = SnapshotStore(os.path.join(args.workdir, "snapshots"),
                           keep=args.keep)
     model = build_model(args.model)
+    sample = (jnp.zeros((args.batch, _LM_DRILL_SEQ), jnp.int32)
+              if args.model.startswith("lm_") else
+              jnp.zeros((args.batch, 28, 28, 1), jnp.float32))
     state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
-                              jnp.zeros((args.batch, 28, 28, 1),
-                                        jnp.float32), seed=args.seed)
+                              sample, seed=args.seed)
     agreed_txt = os.environ.get("FLEET_RESUME_STEP", "")
     if truthy(args.resume):
         if agreed_txt:
@@ -198,7 +220,8 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr, flush=True)
 
     batches = FaultyBatches(
-        _batch_stream(args.batch, args.seed, start_step), plan,
+        _batch_stream(args.batch, args.seed, start_step,
+                      model=args.model), plan,
         start_step=start_step)
     tape = MetricsTapeHook()
     # Order is load-bearing: MetricsHook first so the flight recorder

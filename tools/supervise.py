@@ -87,6 +87,7 @@ def _capture_tasks(start_ts: float,
     profile_out = env.get("PROFILE_OUT", "PROFILE_auto_r05.json")
     bytes_out = env.get("BYTES_OUT", "BYTES_AUDIT_r05.json")
     collectives_out = env.get("COLLECTIVES_OUT", "BENCH_collectives_r06.json")
+    lm_out = env.get("LM_OUT", "BENCH_lm_r08.json")
     trace_tgz = env.get("TRACE_TGZ", "resnet_trace_r05.tgz")
     cli_out = env.get("CLI_OUT", "CLI_r05.log")
     trace_dir = env.get("TRACE_DIR", "/tmp/resnet_trace")
@@ -123,6 +124,7 @@ def _capture_tasks(start_ts: float,
     keep_bytes_json = keep_json(bytes_out + ".tmp", bytes_out)
     keep_collectives_json = keep_json(collectives_out + ".tmp",
                                       collectives_out)
+    keep_lm_json = keep_json(lm_out + ".tmp", lm_out)
 
     def fresh_measured() -> bool:
         """Phase-4 gate from bench_capture.sh: the trainer has no
@@ -178,6 +180,16 @@ def _capture_tasks(start_ts: float,
               "--json", collectives_out + ".tmp"],
              priority=27, stderr_path=log,
              env=bench_env, post=keep_collectives_json),
+        # phase 2d: the graft-LM family (bench_lm.py --real): tokens/sec
+        # + MFU + the lm_base knob A/B matrix on the live backend.  Same
+        # sentinel/platform-labeling discipline as 2c — probes with the
+        # bench env knobs, emits a sentinel when the backend is down,
+        # and under an exported JAX_PLATFORMS=cpu the record self-labels
+        # platform=cpu so CPU numbers never read as chip numbers.
+        Task("lm",
+             [py, "bench_lm.py", "--real", "--json", lm_out + ".tmp"],
+             priority=28, stderr_path=log,
+             env=bench_env, post=keep_lm_json),
         # phase 3: the full six-workload record.
         Task("full_bench", [py, "bench.py"], priority=30, stdout_path=out,
              stderr_path=log, env=bench_env),
