@@ -714,6 +714,11 @@ def main() -> None:
             signal.signal(signal.SIGTERM,
                           prev_term if prev_term is not None
                           else signal.SIG_DFL)
+    # Normal completion closes the ledger row rc=0; every other exit
+    # (SIGTERM, watchdog os._exit, crash) leaves it to atexit/rc=None —
+    # "unreported" is exactly what those deaths are.
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    obs_ledger.end_global(rc=0)
 
 
 def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,
@@ -726,11 +731,23 @@ def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,
     from distributedtensorflowexample_tpu.obs import (
         recorder as obs_recorder)
     obs_recorder.maybe_install(sigterm=False)
+    # Run ledger + live scrape (both env-gated, stdlib-only): the bench
+    # trajectory's per-run bookkeeping lands in RUNS.jsonl (OBS_LEDGER)
+    # and a mid-sweep scrape of /metrics answers on OBS_HTTP_PORT.
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
+    obs_ledger.maybe_begin(
+        "bench", config={"headline_only": HEADLINE_ONLY,
+                         "dequant": DEQUANT, "repeats": REPEATS})
+    obs_serve.maybe_start()
     reachable, _ = _wait_for_backend(into=attempts)
     if not reachable:
         final_once(lambda: emit_unavailable(
             "TPU backend unreachable after probe retries "
             f"(budget {RETRY_BUDGET_S:.0f}s)", attempts))
+        # note= so the ledger can tell a sentinel run from a real
+        # sweep (end is idempotent; main()'s bare rc=0 then no-ops).
+        obs_ledger.end_global(rc=0, note="backend unreachable sentinel")
         return
 
     def fire_watchdog():
@@ -761,6 +778,7 @@ def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,
         watchdog_done.set()
         final_once(lambda: emit_unavailable(
             f"TPU backend unavailable: {e!r}", attempts))
+        obs_ledger.end_global(rc=0, note="backend-unavailable sentinel")
         return
     num_chips = mesh.size
     baselines = _load_baselines()
@@ -1064,6 +1082,8 @@ def _main_run(make_mesh, errors: dict, held_headline: dict, attempts: list,
                 "mid-run backend loss is the known cause of this shape, "
                 "but read detail.errors for the actual per-point failures)",
                 attempts, errors))
+            obs_ledger.end_global(rc=0,
+                                  note="all-sweep-points-failed sentinel")
             return
         if errors:   # attached last so any side-workload failure shows too
             headline_detail["errors"] = errors

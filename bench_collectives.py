@@ -175,7 +175,13 @@ def main() -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from distributedtensorflowexample_tpu.compat import shard_map
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
 
+    # Run ledger + live scrape (env-gated; OBS_LEDGER / OBS_HTTP_PORT):
+    # the same per-run bookkeeping every bench entrypoint now leaves.
+    obs_ledger.maybe_begin("bench_collectives", config=vars(args))
+    obs_serve.maybe_start()
     devices = jax.devices()
     platform = jax.default_backend()
     sizes = [int(s) for s in args.sizes.split(",") if s]
@@ -205,6 +211,11 @@ def main() -> None:
             if args.json:
                 with open(args.json, "w") as f:
                     json.dump(line, f, indent=1)
+            # A deliberate labeled sentinel IS a reported outcome — the
+            # atexit rc=None close is reserved for deaths that never got
+            # to say anything.
+            obs_ledger.end_global(
+                rc=0, note="single-device window sentinel")
             return
         parser.error(f"no usable submesh size (have {len(devices)} devices)")
 
@@ -301,6 +312,7 @@ def main() -> None:
             json.dump(record, f, indent=1)
         print(f"bench_collectives: wrote {args.json}", file=sys.stderr,
               flush=True)
+    obs_ledger.end_global(rc=0)
 
 
 if __name__ == "__main__":

@@ -163,18 +163,24 @@ def bench_gather_augment_u8(n_src: int = 50000, batch: int = 256) -> None:
 
 def main() -> None:
     from distributedtensorflowexample_tpu import native
+    # Run ledger (env-gated; OBS_LEDGER) — same per-run bookkeeping as
+    # the rest of the bench family.
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
 
+    obs_ledger.maybe_begin("bench_input")
     if not native.available():
         print(json.dumps({"metric": "native_loader", "value": 0,
                           "unit": "unavailable", "vs_baseline": 0.0,
                           "detail": {"note": "toolchain/build unavailable; "
                                              "numpy fallback is the only "
                                              "path"}}), flush=True)
+        obs_ledger.end_global(rc=0, note="native loader unavailable")
         return
     bench_cifar_parse()
     bench_idx_parse()
     bench_gather_augment()
     bench_gather_augment_u8()
+    obs_ledger.end_global(rc=0)
 
 
 if __name__ == "__main__":

@@ -415,10 +415,14 @@ def main(argv: list[str] | None = None) -> int:
 
     import jax
 
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
     from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
     from distributedtensorflowexample_tpu.parallel import make_mesh
 
     obs_recorder.maybe_install()
+    obs_ledger.maybe_begin("bench_lm", config=vars(args))
+    obs_serve.maybe_start()
     mesh = make_mesh()
     platform = jax.default_backend()
     lines: list = []
@@ -455,6 +459,7 @@ def main(argv: list[str] | None = None) -> int:
             for rec in lines + [meta]:
                 f.write(json.dumps(rec) + "\n")
         print(f"bench_lm: wrote {args.json}", file=sys.stderr, flush=True)
+    obs_ledger.end_global(rc=0, errors=errors or None)
     return 0
 
 

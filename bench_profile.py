@@ -73,6 +73,11 @@ def main() -> None:
     # chained dump a supervisor wall-timeout TERM would kill it with no
     # postmortem at all.
     obs_recorder.maybe_install()
+    # Run ledger + live scrape (env-gated; OBS_LEDGER / OBS_HTTP_PORT).
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
+    obs_ledger.maybe_begin("bench_profile", config=vars(args))
+    obs_serve.maybe_start()
 
     probe_attempts: list = []
 
@@ -92,6 +97,9 @@ def main() -> None:
     if not reachable:
         emit_unavailable("TPU backend unreachable after probe retries "
                          f"(budget {bench.RETRY_BUDGET_S:.0f}s)")
+        # A reported sentinel is a clean outcome in the ledger too —
+        # rc=None stays reserved for runs that never got to say so.
+        obs_ledger.end_global(rc=0, note="backend unreachable sentinel")
         return
     if bench._cpu_platform():
         # CPU-platform runs (CI / virtual mesh) are legitimately slow —
@@ -115,6 +123,7 @@ def main() -> None:
         emit_unavailable(f"TPU backend unavailable: {e!r}")
         if watchdog_done is not None:
             watchdog_done.set()
+        obs_ledger.end_global(rc=0, note="backend-unavailable sentinel")
         return
     n = mesh.size
     rates = {}
@@ -252,6 +261,7 @@ def main() -> None:
             "detail": detail}), flush=True)
     if watchdog_done is not None:
         watchdog_done.set()
+    obs_ledger.end_global(rc=0, errors=errors or None)
 
 
 if __name__ == "__main__":

@@ -137,6 +137,12 @@ def main() -> None:
     # Same warmup/best-of-repeats measurement the main bench uses.
     from bench import _measure
 
+    # Run ledger + live scrape (env-gated; OBS_LEDGER / OBS_HTTP_PORT).
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
+    obs_ledger.maybe_begin("bench_scaling", config=vars(args))
+    obs_serve.maybe_start()
+
     avail = len(jax.devices())
     counts = [n for n in (1, 2, 4, 8, 16, 32) if n <= min(avail,
                                                           args.max_devices)]
@@ -221,6 +227,7 @@ def main() -> None:
                             "one host's cores, so their efficiency reflects "
                             "per-step overhead trend only")},
     }), flush=True)
+    obs_ledger.end_global(rc=0)
 
 
 if __name__ == "__main__":

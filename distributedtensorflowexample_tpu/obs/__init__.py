@@ -32,6 +32,14 @@ Four cooperating pieces, each usable alone:
   detection, NaN / loss-plateau sentinels; surfaced as registry
   counters, a machine-readable ``health.json``, and flight-recorder
   triggers (a detected anomaly dumps a postmortem BEFORE escalation).
+- :mod:`.ledger` — the CROSS-run record: an append-only, crash-tolerant
+  ``RUNS.jsonl`` (``OBS_LEDGER=<path>``) of run_start / bounded-
+  resolution metric samples / run_end rows plus fleet annotations,
+  queryable live and diffable after the fact (``tools/obs_query.py``).
+- :mod:`.serve` — the LIVE scrape surface: an opt-in
+  (``OBS_HTTP_PORT``) background HTTP thread per process exposing
+  ``/metrics`` (Prometheus text), ``/health`` (the §16 contract),
+  ``/flight`` (on-demand recorder dump), and ``/ledger/tail``.
 
 Deliberately **stdlib-only**: importing obs never pulls jax, so
 bench.py's record-survival contract (its SIGTERM handler must be live
@@ -42,6 +50,10 @@ process both instrument themselves for free.
 from distributedtensorflowexample_tpu.obs.anomaly import (  # noqa: F401
     EwmaRegression, PlateauSentinel, RunHealth, detect_skew, read_health,
     write_health)
+from distributedtensorflowexample_tpu.obs.ledger import (  # noqa: F401
+    RunLedger, run_table)
+from distributedtensorflowexample_tpu.obs.serve import (  # noqa: F401
+    ObsServer)
 from distributedtensorflowexample_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry, counter, gauge, histogram, registry)
 from distributedtensorflowexample_tpu.obs.recorder import (  # noqa: F401

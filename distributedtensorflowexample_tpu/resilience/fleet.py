@@ -79,6 +79,7 @@ two-process pattern tests/test_multihost.py uses, no TPU required.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import random
 import signal
@@ -86,9 +87,11 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.request
 
 from distributedtensorflowexample_tpu.cluster import tf_config_env
 from distributedtensorflowexample_tpu.obs import anomaly as obs_anomaly
+from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
@@ -206,6 +209,10 @@ class FleetSupervisor:
     stderr logs; ``worker_tiled``/``elastic`` select the rank-loss
     reaction."""
 
+    # How long a rank's failed /health scrape keeps the monitor off its
+    # endpoint (file fallback continues) — see _read_rank_health.
+    _HTTP_BACKOFF_S = 5.0
+
     def __init__(self, num_ranks: int,
                  policy: RetryPolicy | None = None,
                  journal: Journal | None = None,
@@ -220,7 +227,10 @@ class FleetSupervisor:
                  workdir: str = "/tmp/fleet",
                  health_path: str | None = None,
                  skew_lag_steps: int = 3,
-                 skew_time_ratio: float = 4.0):
+                 skew_time_ratio: float = 4.0,
+                 ledger_path: str | None = None,
+                 http: bool = False,
+                 http_timeout_s: float = 0.25):
         if num_ranks < 1:
             raise ValueError(f"num_ranks {num_ranks} must be >= 1")
         self.num_ranks = num_ranks
@@ -249,10 +259,61 @@ class FleetSupervisor:
         # steps of a 0.25 s/step straggler) only needs ~0.5 s cadence.
         self._health_poll_s = max(poll_s, 0.5)
         self._rng = random.Random(seed)
+        # Run ledger (obs/ledger.py): exported to every rank (each
+        # child writes its own run rows there) and written by the fleet
+        # itself (gang rows + the resume_agreement annotation) — one
+        # RUNS.jsonl holding the whole drill, queryable with
+        # tools/obs_query.py.  None = the workdir default; "" disables.
+        self.ledger_path = (os.path.join(self.workdir, "RUNS.jsonl")
+                            if ledger_path is None else ledger_path)
+        # Live scrape (obs/serve.py): with http=True each rank gets an
+        # OBS_HTTP_PORT export and the monitor pass prefers scraping
+        # /health over reading the per-rank file — the file stays as
+        # the fallback, so a rank whose server never bound (port taken,
+        # child predates the contract) degrades to exactly the old
+        # behavior instead of going dark.
+        self.http = http
+        self.http_timeout_s = http_timeout_s
+        self._http_ports: dict[int, int] = (
+            {r: _free_port() for r in range(num_ranks)} if http else {})
+        self._scrape_logged: set = set()
+        self._http_backoff: dict[int, float] = {}
+        # This fleet invocation's ledger disambiguator (see _gang_run).
+        self._fleet_run_id = (f"{int(obs_metrics._wall() * 1000):x}"
+                              f"-{os.getpid()}")
         # One port per ORIGINAL rank, chosen once: a gang restart reuses
         # the same coordinator address, like a real re-scheduled job
         # whose hosts keep their endpoints.
         self._ports = [_free_port() for _ in range(num_ranks)]
+
+    def _ledger_dest(self) -> str:
+        """Where THIS fleet's rows go — the SAME resolution the
+        children see (spawn uses ``env.setdefault``, so an operator's
+        box-wide ``OBS_LEDGER`` export wins there too): env first, then
+        the configured workdir default.  One drill must land in ONE
+        file; gang rows split from rank rows would show half the story
+        to either file's reader.  Empty = no fleet rows (and the
+        explicit path below keeps ``log_event``'s own env fallback from
+        resurrecting a disabled ledger).  A PRESENT-but-empty export is
+        "set to disabled", exactly as the children read it
+        (``setdefault`` skips a present key; ``maybe_begin`` treats ""
+        as no ledger) — never a fall-through to the default."""
+        if "OBS_LEDGER" in os.environ:
+            return os.environ["OBS_LEDGER"]
+        return self.ledger_path
+
+    def _ledger_event(self, event: str, **fields) -> None:
+        dest = self._ledger_dest()
+        if dest:
+            obs_ledger.log_event(event, path=dest, src="fleet", **fields)
+
+    def _gang_run(self, name: str, attempt: int) -> str:
+        """Gang row id, unique ACROSS fleet invocations: the ledger is
+        append-only and may hold months of drills against one workdir,
+        and two drills both keyed ``gang:train:a0`` would silently fold
+        into one run on read (the second drill's outcome replacing the
+        first's).  Same wall-ms+pid disambiguation RunLedger ids use."""
+        return f"gang:{name}:{self._fleet_run_id}:a{attempt}"
 
     # --- per-rank plumbing ------------------------------------------------
     @staticmethod
@@ -313,6 +374,17 @@ class FleetSupervisor:
         except OSError:
             pass
         env["OBS_HEALTH"] = hp
+        if self.ledger_path:
+            # setdefault: an operator pointing the whole fleet at one
+            # box-wide ledger (their own OBS_LEDGER export) wins.
+            env.setdefault("OBS_LEDGER", self.ledger_path)
+        if self.http:
+            env["OBS_HTTP_PORT"] = str(self._http_ports[rank])
+            # Say where each rank serves: the whole point is an
+            # operator curling it mid-run.
+            _log(f"rank {rank} scrape endpoint: "
+                 f"http://127.0.0.1:{self._http_ports[rank]} "
+                 f"(/metrics /health /flight /ledger/tail)")
         if self.heartbeat_timeout_s:
             env["SUPERVISE_HEARTBEAT_TIMEOUT_S"] = str(
                 self.heartbeat_timeout_s)
@@ -381,6 +453,55 @@ class FleetSupervisor:
         obs_recorder.dump_global(f"gang_teardown_{why}", final=False)
 
     # --- online anomaly monitoring (detection ONLY) -----------------------
+    def _read_rank_health(self, rank: int, name: str,
+                          attempt: int) -> dict | None:
+        """One rank's health payload: HTTP scrape of the rank's
+        ``/health`` endpoint first (obs/serve.py, when this fleet
+        exported a port), the per-rank file as the fallback.  The first
+        read per (rank, mode) per gang attempt journals a
+        ``health_scrape`` event, so a postmortem can prove which
+        transport the monitor actually used — and see a fallback happen.
+        Detection-only contract unchanged: every failure degrades to
+        the file, and a missing file is still just None.  A rank whose
+        scrape just failed is skipped for ``_HTTP_BACKOFF_S``: these
+        urlopens are SERIAL inside the monitor loop, and N wedged-but-
+        bound endpoints each eating the full timeout would stall
+        rank-exit/SIGTERM polling by N x timeout per pass — exactly
+        when the fleet is unhealthy."""
+        port = self._http_ports.get(rank) if self.http else None
+        if port and time.monotonic() >= self._http_backoff.get(rank, 0.0):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health",
+                        timeout=self.http_timeout_s) as resp:
+                    payload = json.loads(resp.read().decode())
+                if isinstance(payload, dict):
+                    self._http_backoff.pop(rank, None)
+                    if (rank, "http") not in self._scrape_logged:
+                        self._scrape_logged.add((rank, "http"))
+                        self.journal.write("health_scrape", task=name,
+                                           attempt=attempt, rank=rank,
+                                           mode="http", port=port)
+                    return payload
+                # Parseable-but-not-ours (a squatter on the rank's
+                # pre-allocated port answering arrays): a failure for
+                # backoff purposes too, or every pass re-pays the
+                # round-trip the backoff exists to avoid.
+                self._http_backoff[rank] = (time.monotonic()
+                                            + self._HTTP_BACKOFF_S)
+            except Exception:
+                # Not bound yet / child gone / wedged: fall back, and
+                # give this rank's endpoint a breather before retrying.
+                self._http_backoff[rank] = (time.monotonic()
+                                            + self._HTTP_BACKOFF_S)
+        payload = obs_anomaly.read_health(self._health_path(rank))
+        if payload is not None \
+                and (rank, "file") not in self._scrape_logged:
+            self._scrape_logged.add((rank, "file"))
+            self.journal.write("health_scrape", task=name,
+                               attempt=attempt, rank=rank, mode="file")
+        return payload
+
     def _stale_beat_span(self, rank: int, now: float) -> float | None:
         """A live rank's no-beat span, reported ONLY when it is stale
         relative to that rank's OWN observed beat cadence (the longest
@@ -433,7 +554,7 @@ class FleetSupervisor:
         # against (and a finished rank can never be flagged itself:
         # lagging requires trailing the front).
         for r in ranks_all:
-            payload = obs_anomaly.read_health(self._health_path(r))
+            payload = self._read_rank_health(r, name, attempt)
             if payload is None:
                 continue
             payloads[r] = payload
@@ -519,6 +640,8 @@ class FleetSupervisor:
         # straggler must not suppress this attempt's journal line.
         self._stragglers: set = set()
         self._flagged: set = set()
+        self._scrape_logged = set()     # (rank, transport) per attempt
+        self._http_backoff = {}         # fresh children, fresh endpoints
         self._health_polled_t = -float("inf")
         self._beat_obs: dict = {}       # rank -> (mtime, seen_at, interval)
         # Stale-file reset, same reason as the per-rank files at spawn:
@@ -537,6 +660,13 @@ class FleetSupervisor:
         self.journal.write("gang_start", task=name, attempt=attempt,
                            ranks=list(self.ranks),
                            resume_step=agreed)
+        # Gang-level ledger row (each rank writes its own run rows to
+        # the same OBS_LEDGER this fleet exported): one row per gang
+        # attempt, closed with the outcome in run()'s retry loop.
+        self._ledger_event(
+            "run_start", run=self._gang_run(name, attempt),
+            entrypoint=name, attempt=attempt, ranks=list(self.ranks),
+            resume_step=agreed)
         # The handler covers the SPAWN loop too: a SIGTERM landing
         # between two spawns must still reach the children already
         # launched into their own sessions — the default disposition
@@ -691,6 +821,13 @@ class FleetSupervisor:
             "resume_agreement", task=name, agreed=agreed,
             per_rank={str(r): v for r, v in per_rank.items()},
             discarded={str(r): v for r, v in discarded.items()})
+        # The same agreement lands in the run ledger: obs_query renders
+        # it between the attempts it separates, so "what did the gang
+        # agree to resume from" is answerable without the journal.
+        self._ledger_event(
+            "resume_agreement", task=name, agreed=agreed,
+            per_rank={str(r): v for r, v in per_rank.items()},
+            discarded={str(r): v for r, v in discarded.items()})
         _log(f"{name}: resume-step agreement: "
              + ", ".join(f"rank {r} had {per_rank[r] or 'nothing'}"
                          for r in sorted(per_rank))
@@ -730,6 +867,10 @@ class FleetSupervisor:
                     self.journal.write(
                         "gang_end", task=name, attempt=attempt,
                         outcome=outcome, why=why,
+                        rcs={str(r): rc for r, rc in sorted(last.items())})
+                    self._ledger_event(
+                        "run_end", run=self._gang_run(name, attempt),
+                        outcome=outcome,
                         rcs={str(r): rc for r, rc in sorted(last.items())})
                     if outcome == "ok":
                         attrs["status"] = "ok"

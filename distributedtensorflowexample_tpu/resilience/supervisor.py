@@ -47,6 +47,7 @@ import sys
 import time
 from typing import Callable
 
+from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
@@ -396,6 +397,19 @@ class Supervisor:
                 env.update(env_extra)
             self.journal.write("attempt_start", task=name, attempt=attempt,
                                argv=argv)
+            # Per-attempt ledger rows (OBS_LEDGER, inherited by the
+            # child which writes its OWN run rows too): the supervisor
+            # is the authoritative rc source — a SIGKILLed child never
+            # gets to close its own row, this one always closes.
+            # wall-ms in the id (the RunLedger/fleet idiom): the ledger
+            # is append-only for months and a recycled pid would fold
+            # two invocations' attempt rows into one run on read.
+            ledger_run = (f"sup:{name}:a{attempt}:"
+                          f"{int(obs_metrics._wall() * 1000):x}"
+                          f"-{os.getpid()}")
+            obs_ledger.log_event(
+                "run_start", run=ledger_run, src="supervisor",
+                entrypoint=name, attempt=attempt, pid=os.getpid())
             tmp = f"{stdout_path}.tmp" if stdout_path else None
             out = open(tmp, "wb") if tmp else None
             # Append mode: one log accumulates every attempt's prose,
@@ -423,6 +437,8 @@ class Supervisor:
                     os.remove(tmp)
             self.journal.write("attempt_end", task=name, attempt=attempt,
                                rc=rc, reason=reason)
+            obs_ledger.log_event("run_end", run=ledger_run,
+                                 src="supervisor", rc=rc, reason=reason)
             _EXITS.labels(outcome=(
                 "ok" if rc == 0 else
                 "terminated" if reason == "supervisor_sigterm" else

@@ -614,6 +614,20 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             rec.note(collectives_per_step=collectives["multiset"],
                      collective_bytes_per_step=collectives[
                          "total_out_bytes_per_step"])
+    # Cross-run ledger (OBS_LEDGER) + live scrape surface
+    # (OBS_HTTP_PORT): the run_start row carries the RESOLVED config —
+    # what obs_query diffs two runs by — and MetricsHook feeds the
+    # bounded samples; /metrics and /health answer while training.
+    import dataclasses as _dc
+
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
+    obs_ledger.maybe_begin(
+        entrypoint=f"trainer:{model_name}",
+        config=_dc.asdict(cfg),
+        platform=jax.default_backend(), mesh_size=num_replicas,
+        num_processes=jax.process_count(), dataset=dataset_name)
+    obs_serve.maybe_start()
 
     with sigterm_flag() as preempted:
         with mesh:
@@ -648,6 +662,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                 # Explicit dump (not just atexit): the postmortem should
                 # say PREEMPTED, with the final step/loss already rung.
                 obs_recorder.dump_global("preempted")
+                # The ledger row too — atexit would close it rc=None
+                # ("never reported"), but this exit DID report.
+                obs_ledger.end_global(rc=143, final_step=int(state.step))
                 raise SystemExit(143)
             final_acc = eval_fn(state)
 
@@ -657,6 +674,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     logger.scalar(int(state.step), "final_accuracy", final_acc)
     steps_per_sec = logger.last_steps_per_sec
     logger.close()
+    obs_ledger.end_global(rc=0, final_step=int(state.step),
+                          final_accuracy=round(float(final_acc), 6))
     return {"final_accuracy": final_acc,
             "steps": int(state.step),
             "steps_per_sec": steps_per_sec,

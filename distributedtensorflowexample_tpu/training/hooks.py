@@ -12,6 +12,7 @@ import os
 import time
 from typing import TYPE_CHECKING
 
+from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
@@ -253,6 +254,13 @@ class MetricsHook(Hook):
                         obs_metrics.MetricsRegistry.delta(
                             self._prev_snap, snap))
                 self._prev_snap = snap
+            # Run-ledger sample (OBS_LEDGER): piggybacks on the log
+            # boundary this hook already owns, and the ledger's own
+            # TIME bound (OBS_LEDGER_SAMPLE_S) keeps the file kilobytes
+            # no matter the cadence — nothing on non-mark boundaries.
+            led = obs_ledger.get()
+            if led is not None:
+                led.sample(step)
         return False
 
 
@@ -297,6 +305,12 @@ class AnomalyHook(Hook):
         self._last_step = 0
         self._last_t = time.perf_counter()
         self._last_excl = sum(c.sum for c in self._spans)
+        # This hook's RunHealth IS the process's live health: register
+        # it as the /health source so an HTTP scrape (obs/serve.py,
+        # OBS_HTTP_PORT) serves the same §16 payload the health FILE
+        # gets at hook cadence — but read at scrape time, not file age.
+        from distributedtensorflowexample_tpu.obs import serve as obs_serve
+        obs_serve.set_health_source(self._health.payload)
 
     def begin(self, loop) -> None:
         self._due = _EveryN(self._every, int(loop.start_step))

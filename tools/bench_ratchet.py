@@ -68,16 +68,33 @@ def _round_of(path: str) -> int:
 def load_records(paths: list[str]) -> list[dict]:
     """All non-provisional record lines, oldest round first.  Torn or
     non-JSON lines are skipped (a SIGKILLed bench leaves them; the
-    ratchet reads what survived, like every other postmortem reader)."""
+    ratchet reads what survived, like every other postmortem reader).
+    A file that yields NO per-line records is retried as one
+    pretty-printed JSON document — bench_collectives writes its record
+    with ``indent=1``, and a per-line-only parser silently dropped that
+    whole family from both the ratchet and the trajectory."""
     records = []
+
+    def _keep(rec, path) -> bool:
+        if not isinstance(rec, dict) or "metric" not in rec:
+            return False
+        detail = rec.get("detail") or {}
+        if rec.get("unit") == "unavailable" or detail.get("provisional"):
+            return False        # sentinel, not a measurement
+        rec["_file"] = os.path.basename(path)
+        rec["_round"] = _round_of(path)
+        records.append(rec)
+        return True
+
     for path in sorted(paths, key=lambda p: (_round_of(p),
                                              os.path.basename(p))):
         try:
             with open(path) as f:
-                lines = f.read().splitlines()
+                text = f.read()
         except OSError:
             continue
-        for line in lines:
+        kept = 0
+        for line in text.splitlines():
             line = line.strip()
             if not line:
                 continue
@@ -85,14 +102,12 @@ def load_records(paths: list[str]) -> list[dict]:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if not isinstance(rec, dict) or "metric" not in rec:
-                continue
-            detail = rec.get("detail") or {}
-            if rec.get("unit") == "unavailable" or detail.get("provisional"):
-                continue        # sentinel, not a measurement
-            rec["_file"] = os.path.basename(path)
-            rec["_round"] = _round_of(path)
-            records.append(rec)
+            kept += _keep(rec, path)
+        if not kept:
+            try:
+                _keep(json.loads(text), path)
+            except json.JSONDecodeError:
+                pass
     return records
 
 
@@ -226,6 +241,117 @@ def armed_predictions(baselines: dict, records: list[dict]) -> list[dict]:
     return out
 
 
+_TRAJECTORY_NAME = "BENCH_trajectory.json"
+
+
+def _family_of(path: str) -> str:
+    """Family = the record filename with round and extension stripped:
+    BENCH_lm_cpu_r08.json -> BENCH_lm_cpu, SCALING_r05_sync.json ->
+    SCALING_sync, BENCH_r01.json -> BENCH — the stable axis the
+    trajectory pivots on."""
+    base = os.path.basename(path)
+    if base.endswith(".json"):
+        base = base[:-5]
+    return _ROUND_RE.sub("", base)
+
+
+def _scaling_metrics(path: str) -> dict:
+    """SCALING_* files are per-devices rows, not "metric" records:
+    flatten each to ``<n>dev_steps_per_sec`` (plus any real metric
+    lines, e.g. the weak-scaling efficiency tail)."""
+    metrics: dict = {}
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return metrics
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if (rec.get("detail") or {}).get("provisional"):
+            continue        # same sentinel rejection as load_records
+        if rec.get("metric") and rec.get("unit") != "unavailable":
+            metrics[rec["metric"]] = rec.get("value")
+        elif rec.get("devices") is not None \
+                and rec.get("steps_per_sec") is not None:
+            metrics[f"{rec['devices']}dev_steps_per_sec"] = \
+                rec["steps_per_sec"]
+    return metrics
+
+
+def build_trajectory(records_dir: str) -> list[dict]:
+    """One row per bench family per round — the canonical cross-round
+    view of the whole perf trajectory, pivoted out of the 20+ record
+    files external tooling otherwise sees as an unreadable pile.
+    Deterministic (sorted rows, sorted metric keys, no timestamps): a
+    regeneration with unchanged records is byte-identical, so the
+    checked-in artifact diffs like code."""
+    rows: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(records_dir,
+                                              "BENCH_*.json"))):
+        if os.path.basename(path) == _TRAJECTORY_NAME:
+            continue        # never its own source
+        recs = load_records([path])
+        if not recs:
+            continue
+        metrics: dict = {}
+        platforms: set = set()
+        for rec in recs:
+            metrics[rec["metric"]] = rec.get("value")
+            platforms.add(_platform(rec))
+        rows.append({"family": _family_of(path),
+                     "round": _round_of(path),
+                     "file": os.path.basename(path),
+                     "platforms": sorted(platforms),
+                     "n_records": len(recs),
+                     "metrics": {k: metrics[k] for k in sorted(metrics)}})
+    for path in sorted(glob.glob(os.path.join(records_dir,
+                                              "SCALING_*.json"))):
+        metrics = _scaling_metrics(path)
+        if not metrics:
+            continue
+        rows.append({"family": _family_of(path),
+                     "round": _round_of(path),
+                     "file": os.path.basename(path),
+                     "platforms": ["cpu"],      # every SCALING record
+                     "n_records": len(metrics),
+                     "metrics": {k: metrics[k] for k in sorted(metrics)}})
+    base_path = os.path.join(records_dir, "BASELINE_SELF.json")
+    try:
+        with open(base_path) as f:
+            baselines = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        baselines = {}
+    numeric = {k: v for k, v in baselines.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    if numeric:
+        rows.append({"family": "BASELINE_SELF", "round": None,
+                     "file": "BASELINE_SELF.json", "platforms": ["chip"],
+                     "n_records": len(numeric),
+                     "metrics": {k: numeric[k] for k in sorted(numeric)}})
+    rows.sort(key=lambda r: (r["family"],
+                             -1 if r["round"] is None else r["round"],
+                             r["file"]))
+    return rows
+
+
+def write_trajectory(records_dir: str, out_path: str) -> int:
+    rows = build_trajectory(records_dir)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    os.replace(tmp, out_path)
+    return len(rows)
+
+
 def check_floor(floor_path: str, dots: int | None,
                 raise_to: int | None) -> tuple[list[str], list[str]]:
     """(errors, info).  The floor file is the ratchet's only writable
@@ -284,11 +410,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--strict", action="store_true",
                    help="self-baseline drops gate too (same-window-"
                         "comparable runs only)")
+    p.add_argument("--trajectory", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="also (re)generate the canonical cross-round "
+                        "trajectory artifact — one JSON line per bench "
+                        "family per round, pivoted from the BENCH_*/"
+                        "SCALING_*/BASELINE_SELF records (default PATH: "
+                        f"<records_dir>/{_TRAJECTORY_NAME})")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable verdict on stdout")
     args = p.parse_args(argv)
 
-    paths = sorted(glob.glob(os.path.join(args.records_dir, args.glob)))
+    paths = sorted(p for p in glob.glob(os.path.join(args.records_dir,
+                                                     args.glob))
+                   if os.path.basename(p) != _TRAJECTORY_NAME)
     records = load_records(paths)
     baseline_path = args.baseline or os.path.join(args.records_dir,
                                                   "BASELINE_SELF.json")
@@ -297,6 +432,12 @@ def main(argv: list[str] | None = None) -> int:
             baselines = json.load(f)
     except (OSError, json.JSONDecodeError):
         baselines = {}
+
+    trajectory_rows = None
+    if args.trajectory is not None:
+        out_path = args.trajectory or os.path.join(args.records_dir,
+                                                   _TRAJECTORY_NAME)
+        trajectory_rows = write_trajectory(args.records_dir, out_path)
 
     outages = outage_rounds(args.records_dir)
     findings = compare_records(records, args.tolerance, args.noise,
@@ -313,12 +454,17 @@ def main(argv: list[str] | None = None) -> int:
                "findings": findings, "armed_predictions": armed,
                "floor": {"errors": floor_errors, "info": floor_info},
                "unexplained": len(gate) + len(floor_errors)}
+    if trajectory_rows is not None:
+        verdict["trajectory_rows"] = trajectory_rows
     if args.as_json:
         json.dump(verdict, sys.stdout, indent=1, default=str)
         print()
     else:
         print(f"bench_ratchet: {len(records)} records in {len(paths)} "
               f"files")
+        if trajectory_rows is not None:
+            print(f"  [trajectory] {trajectory_rows} family-round rows "
+                  f"written")
         for f_ in findings:
             print(f"  [{f_['severity']}] {f_['metric']} ({f_['platform']}):"
                   f" {f_['prior']:g} ({f_['prior_file']}) -> "

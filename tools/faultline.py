@@ -168,6 +168,16 @@ def main(argv: list[str] | None = None) -> int:
     if rec is not None:
         rec.note(tool="faultline", plan=args.plan, model=args.model,
                  workdir=args.workdir)
+    # Run ledger + live scrape (env-gated): a fleet drill's per-attempt
+    # rows land in the RUNS.jsonl the fleet supervisor exported, and
+    # OBS_HTTP_PORT answers /metrics///health while the drill runs.
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    from distributedtensorflowexample_tpu.obs import serve as obs_serve
+    obs_ledger.maybe_begin(
+        "faultline", config={"plan": args.plan, "steps": args.steps,
+                             "model": args.model, "seed": args.seed,
+                             "batch": args.batch, "rank": rank})
+    obs_serve.maybe_start()
     plan = FaultPlan.parse(args.plan, args.steps, args.seed)
     if any(s.rank is not None for s in plan.specs):
         # Every rank parses the SAME text (same seed anchor), then keeps
@@ -209,6 +219,7 @@ def main(argv: list[str] | None = None) -> int:
                           f"holds it; refusing to resume from a "
                           f"divergent snapshot", file=sys.stderr,
                           flush=True)
+                    obs_ledger.end_global(rc=1)
                     return 1
                 state = store.restore(state, step=agreed)
             # agreed == 0: no common step existed — start fresh.
@@ -270,7 +281,10 @@ def main(argv: list[str] | None = None) -> int:
             # loop (its buffers are gone), and a poisoned state has no
             # parity claim to attest anyway.
             print(f"faultline: {e}", file=sys.stderr, flush=True)
-            emit("fault", error=str(e), step=start_step + len(tape.tape))
+            emit("fault", error=str(e),
+                 step=start_step + len(tape.tape))
+            obs_ledger.end_global(rc=1,
+                                  final_step=start_step + len(tape.tape))
             return 1
         # Post-exit faults: applied AFTER the final save — the torn
         # snapshot/journal shapes recovery must survive by falling back
@@ -294,8 +308,10 @@ def main(argv: list[str] | None = None) -> int:
         if preempted:
             obs_recorder.dump_global("preempted")
             emit("preempted", digest_state=state)
+            obs_ledger.end_global(rc=143, final_step=int(state.step))
             return 143
     emit("ok", digest_state=state)
+    obs_ledger.end_global(rc=0, final_step=int(state.step))
     return 0
 
 

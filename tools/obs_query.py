@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""obs_query — cross-run queries over the run ledger and the bench
+record families: list runs, diff two runs, render metric trajectories.
+
+  # what ran (and how it ended), newest last:
+  python tools/obs_query.py list --ledger /tmp/fleet/RUNS.jsonl
+  # only trainer runs that crashed:
+  python tools/obs_query.py list --ledger RUNS.jsonl \
+      --entrypoint trainer --outcome rc
+  # everything the ledger knows about one run (start/samples/end):
+  python tools/obs_query.py show --ledger RUNS.jsonl 19fc2-1234
+  # config + metric deltas between two runs (id prefixes resolve):
+  python tools/obs_query.py diff --ledger RUNS.jsonl 19fc2 19fd8
+  # the bench trajectory, per family per round:
+  python tools/obs_query.py trajectory --format md
+
+Rows come from ``obs/ledger.py``'s RUNS.jsonl (``OBS_LEDGER``; the
+fleet supervisor writes <workdir>/RUNS.jsonl by default): ``run_start``
+/ ``sample`` / ``run_end`` per run plus the fleet's gang rows and
+``resume_agreement`` annotations.  ``diff`` answers the question the
+pile of per-run files never could — "these two runs differ HOW": the
+config keys that changed (run_start carries the resolved config), the
+final-counter deltas (run_end carries cumulative counters), loss-tail
+digests (same trajectory or not), outcome and anomaly flags.
+``trajectory`` pivots the ``BENCH_*``/``SCALING_*``/``BASELINE_SELF``
+records through tools/bench_ratchet.py's builder — the same rows the
+checked-in ``BENCH_trajectory.json`` artifact holds.
+
+Stdlib-only and read-only (like obs_report): safe mid-outage, and
+``--format json`` makes every view machine-consumable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_REPO, os.path.dirname(os.path.abspath(__file__))):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+from distributedtensorflowexample_tpu.obs import ledger as obs_ledger  # noqa: E402
+from obs_report import _table as _table_lines  # noqa: E402  (tools/)
+
+
+def _table(headers: list[str], rows: list[list]) -> str:
+    """obs_report's markdown table builder, joined, with Nones blanked
+    — ONE table dialect across the two query/report CLIs."""
+    return "\n".join(_table_lines(
+        headers, [["" if c is None else c for c in row] for row in rows]))
+
+
+def _emit(payload, md: str, fmt: str) -> None:
+    if fmt == "json":
+        json.dump(payload, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(md)
+
+
+# --- list ------------------------------------------------------------------
+
+def cmd_list(args) -> int:
+    folded = obs_ledger.runs(args.ledger)
+    table = obs_ledger.run_table(args.ledger, folded=folded)
+    if args.entrypoint:
+        table = [r for r in table
+                 if args.entrypoint in str(r.get("entrypoint") or "")]
+    if args.outcome:
+        table = [r for r in table
+                 if args.outcome in str(r.get("outcome") or "")]
+    agreements = [e for e in folded["events"]
+                  if e.get("event") == "resume_agreement"]
+    md_rows = [[r["run"], r["entrypoint"], r["rank"], r["attempt"],
+                r["outcome"], r["final_step"], r["samples"],
+                r["anomalies"] or "",
+                "" if r["duration_s"] is None else f"{r['duration_s']:g}"]
+               for r in table]
+    md = [f"# Runs — `{os.path.basename(args.ledger)}` "
+          f"({len(table)} run(s)"
+          + (f", {folded['torn']} torn line(s) skipped"
+             if folded["torn"] else "") + ")", "",
+          _table(["run", "entrypoint", "rank", "att", "outcome", "step",
+                  "samples", "anom", "dur_s"], md_rows)]
+    if agreements:
+        md += ["", "## Resume agreements", ""]
+        md += [f"- agreed step **{a.get('agreed')}** "
+               f"(task {a.get('task')}): per-rank "
+               f"{a.get('per_rank')}, discarded {a.get('discarded')}"
+               for a in agreements]
+    _emit({"runs": table, "agreements": agreements,
+           "torn": folded["torn"]}, "\n".join(md), args.format)
+    return 0
+
+
+# --- show ------------------------------------------------------------------
+
+def _resolve_run(folded: dict, token: str) -> str:
+    """Exact id or unique prefix — eight hex chars beat pasting the
+    whole id into a terminal."""
+    if token in folded["runs"]:
+        return token
+    matches = [r for r in folded["order"] if r.startswith(token)]
+    if len(matches) == 1:
+        return matches[0]
+    raise SystemExit(
+        f"obs_query: run {token!r} "
+        + ("is ambiguous: " + ", ".join(matches) if matches
+           else "not found — `obs_query list` shows the ids"))
+
+
+def cmd_show(args) -> int:
+    folded = obs_ledger.runs(args.ledger)
+    run_id = _resolve_run(folded, args.run)
+    group = folded["runs"][run_id]
+    md = [f"# Run `{run_id}`", ""]
+    for name, row in (("run_start", group["start"]),
+                      ("run_end", group["end"])):
+        if row:
+            md += [f"## {name}", "", "```json",
+                   json.dumps(row, indent=1, sort_keys=True), "```", ""]
+    if group["samples"]:
+        md += [f"## samples ({len(group['samples'])})", ""]
+        rows = [[s.get("step"),
+                 (s.get("delta") or {}).get("span_s"),
+                 json.dumps((s.get("delta") or {}).get("counters") or {},
+                            sort_keys=True)]
+                for s in group["samples"]]
+        md += [_table(["step", "span_s", "counter deltas"], rows)]
+    _emit(group, "\n".join(md), args.format)
+    return 0
+
+
+# --- diff ------------------------------------------------------------------
+
+def diff_runs(folded: dict, id_a: str, id_b: str) -> dict:
+    a, b = folded["runs"][id_a], folded["runs"][id_b]
+
+    def cfg(g):
+        return ((g["start"] or {}).get("config") or {})
+
+    keys = sorted(set(cfg(a)) | set(cfg(b)))
+    config_diff = {k: {"a": cfg(a).get(k), "b": cfg(b).get(k)}
+                   for k in keys if cfg(a).get(k) != cfg(b).get(k)}
+
+    def counters(g):
+        return ((g["end"] or {}).get("counters") or {})
+
+    ckeys = sorted(set(counters(a)) | set(counters(b)))
+    metric_delta = {}
+    for k in ckeys:
+        va, vb = counters(a).get(k), counters(b).get(k)
+        if va != vb:
+            metric_delta[k] = {
+                "a": va, "b": vb,
+                "delta": (None if not isinstance(va, (int, float))
+                          or not isinstance(vb, (int, float))
+                          else round(vb - va, 6))}
+
+    def end_field(g, f):
+        return (g["end"] or {}).get(f)
+
+    tails = {which: end_field(g, "loss_tail")
+             for which, g in (("a", a), ("b", b))}
+    return {
+        "a": {"run": id_a, **{f: (a["start"] or {}).get(f)
+                              for f in ("entrypoint", "config_digest",
+                                        "rank", "attempt")}},
+        "b": {"run": id_b, **{f: (b["start"] or {}).get(f)
+                              for f in ("entrypoint", "config_digest",
+                                        "rank", "attempt")}},
+        "config_diff": config_diff,
+        "outcome": {"a": {"rc": end_field(a, "rc"),
+                          "final_step": end_field(a, "final_step")},
+                    "b": {"rc": end_field(b, "rc"),
+                          "final_step": end_field(b, "final_step")}},
+        "loss_tail": {**tails,
+                      "same_trajectory": (
+                          None if not tails["a"] or not tails["b"]
+                          else tails["a"].get("sha256")
+                          == tails["b"].get("sha256"))},
+        "anomaly_flags": {"a": end_field(a, "anomaly_flags"),
+                          "b": end_field(b, "anomaly_flags")},
+        "counter_deltas": metric_delta}
+
+
+def cmd_diff(args) -> int:
+    folded = obs_ledger.runs(args.ledger)
+    id_a = _resolve_run(folded, args.run_a)
+    id_b = _resolve_run(folded, args.run_b)
+    d = diff_runs(folded, id_a, id_b)
+    md = [f"# Run diff — `{id_a}` (a) vs `{id_b}` (b)", "",
+          f"- **a**: {d['a']['entrypoint']} "
+          f"(config {d['a']['config_digest']}, rank {d['a']['rank']}, "
+          f"attempt {d['a']['attempt']}) → rc={d['outcome']['a']['rc']} "
+          f"@ step {d['outcome']['a']['final_step']}",
+          f"- **b**: {d['b']['entrypoint']} "
+          f"(config {d['b']['config_digest']}, rank {d['b']['rank']}, "
+          f"attempt {d['b']['attempt']}) → rc={d['outcome']['b']['rc']} "
+          f"@ step {d['outcome']['b']['final_step']}"]
+    same = d["loss_tail"]["same_trajectory"]
+    if same is not None:
+        md.append(f"- **loss trajectory**: "
+                  + ("IDENTICAL (tail digests match)" if same
+                     else "differs (tail digests disagree)"))
+    md += ["", "## Config diff", ""]
+    if d["config_diff"]:
+        md.append(_table(["key", "a", "b"],
+                         [[k, v["a"], v["b"]]
+                          for k, v in sorted(d["config_diff"].items())]))
+    else:
+        md.append("- identical resolved configs "
+                  f"(digest {d['a']['config_digest']})")
+    md += ["", "## Counter deltas (b - a)", ""]
+    if d["counter_deltas"]:
+        md.append(_table(
+            ["counter", "a", "b", "delta"],
+            [[f"`{k}`", v["a"], v["b"], v["delta"]]
+             for k, v in sorted(d["counter_deltas"].items())]))
+    else:
+        md.append("- no counter differences")
+    _emit(d, "\n".join(md), args.format)
+    return 0
+
+
+# --- trajectory ------------------------------------------------------------
+
+def cmd_trajectory(args) -> int:
+    import bench_ratchet
+    rows = bench_ratchet.build_trajectory(args.records_dir)
+    if args.family:
+        rows = [r for r in rows if args.family in r["family"]]
+    md = [f"# Bench trajectory — {len(rows)} family-round row(s)", ""]
+    for row in rows:
+        rnd = "—" if row["round"] is None else f"r{row['round']:02d}"
+        md += [f"## {row['family']} {rnd} (`{row['file']}`, "
+               f"{'/'.join(row['platforms'])})", "",
+               _table(["metric", "value"],
+                      [[f"`{k}`", v]
+                       for k, v in sorted(row["metrics"].items())]), ""]
+    _emit(rows, "\n".join(md), args.format)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_common(sp, ledger: bool = True):
+        sp.add_argument("--format", default="md", choices=["md", "json"])
+        if ledger:
+            # `or`: a present-but-EMPTY export means "ledger disabled"
+            # everywhere else (fleet, maybe_begin) — fall through to
+            # the ./RUNS.jsonl default the help text promises.
+            sp.add_argument("--ledger", default=os.environ.get(
+                "OBS_LEDGER") or "RUNS.jsonl",
+                help="RUNS.jsonl path (default: $OBS_LEDGER, else "
+                     "./RUNS.jsonl)")
+
+    sp = sub.add_parser("list", help="run table + agreements")
+    add_common(sp)
+    sp.add_argument("--entrypoint", default="",
+                    help="substring filter on the entrypoint")
+    sp.add_argument("--outcome", default="",
+                    help="substring filter on the outcome "
+                         "(ok/preempted/rc=.../running)")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("show", help="one run's rows in full")
+    add_common(sp)
+    sp.add_argument("run", help="run id (or unique prefix)")
+    sp.set_defaults(fn=cmd_show)
+
+    sp = sub.add_parser("diff", help="config + metric deltas between "
+                                     "two runs")
+    add_common(sp)
+    sp.add_argument("run_a")
+    sp.add_argument("run_b")
+    sp.set_defaults(fn=cmd_diff)
+
+    sp = sub.add_parser("trajectory", help="per-family per-round bench "
+                                           "metric trajectories")
+    add_common(sp, ledger=False)
+    sp.add_argument("--records_dir", default=_REPO)
+    sp.add_argument("--family", default="",
+                    help="substring filter on the family")
+    sp.set_defaults(fn=cmd_trajectory)
+
+    args = p.parse_args(argv)
+    if getattr(args, "ledger", None) is not None \
+            and args.cmd != "trajectory" \
+            and not os.path.exists(args.ledger) \
+            and not os.path.exists(args.ledger + ".1"):
+        p.error(f"ledger {args.ledger} does not exist (pass --ledger or "
+                f"export OBS_LEDGER)")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `obs_query list | head` closing the pipe early is a normal
+        # way to read a long table, not an error worth a traceback.
+        os._exit(0)

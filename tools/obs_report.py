@@ -299,6 +299,36 @@ def render_anatomy(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_ledger(path: str) -> str:
+    """Run-ledger section (obs/ledger.py RUNS.jsonl): the run table —
+    entrypoint, attempts, outcome, anomalies — next to the flights and
+    journal those runs left, plus the fleet's resume agreements.
+    Unreadable/missing renders as a note, never a raise (the report
+    must come out mid-outage)."""
+    from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+    lines = [f"## Run ledger — `{os.path.basename(path)}`", ""]
+    if not os.path.exists(path) and not os.path.exists(path + ".1"):
+        return "\n".join(lines + [f"- unreadable: {path} does not exist"])
+    folded = obs_ledger.runs(path)
+    table = obs_ledger.run_table(path, folded=folded)
+    rows = [[r["run"], r["entrypoint"], r["rank"], r["attempt"],
+             r["outcome"], r["final_step"], r["samples"],
+             r["anomalies"] or ""] for r in table]
+    lines += _table(["run", "entrypoint", "rank", "att", "outcome",
+                     "step", "samples", "anomalies"],
+                    [[("" if c is None else c) for c in row]
+                     for row in rows])
+    agreements = [e for e in folded["events"]
+                  if e.get("event") == "resume_agreement"]
+    for a in agreements:
+        lines.append(f"- **resume agreement**: step {a.get('agreed')} "
+                     f"(per-rank {a.get('per_rank')}, discarded "
+                     f"{a.get('discarded')})")
+    if folded["torn"]:
+        lines.append(f"- **torn ledger lines skipped**: {folded['torn']}")
+    return "\n".join(lines)
+
+
 def render_health(payloads: list[dict]) -> str:
     """Health section: fleet aggregates first (stragglers + why), then
     per-rank detector flags that fired."""
@@ -348,6 +378,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--health", action="append", default=[],
                    help="extra health.json files to merge (those next "
                         "to --dir/--journal are discovered)")
+    p.add_argument("--ledger", default="",
+                   help="run ledger (RUNS.jsonl, obs/ledger.py) to "
+                        "render as a run table alongside the flights "
+                        "and journal")
     p.add_argument("--max_spans", type=int, default=12)
     p.add_argument("--max_loss", type=int, default=8)
     args = p.parse_args(argv)
@@ -360,9 +394,10 @@ def main(argv: list[str] | None = None) -> int:
     sources["health_paths"] = sorted(set(sources["health_paths"])
                                      | set(args.health))
     if not sources["flight_paths"] and not sources["health_paths"] \
-            and not args.journal and not args.trace_glob:
+            and not args.journal and not args.trace_glob \
+            and not args.ledger:
         p.error("nothing to render: pass flight files, --dir, "
-                "--trace_glob, --health, or --journal")
+                "--trace_glob, --health, --ledger, or --journal")
     merged = obs_timeline.merge(**sources)
 
     if args.format == "trace":
@@ -398,7 +433,8 @@ def main(argv: list[str] | None = None) -> int:
                                       max_loss=args.max_loss))
     sections.append(render_coverage(merged))
     for section in (render_anatomy(anatomy),
-                    render_health(merged["health"])):
+                    render_health(merged["health"]),
+                    render_ledger(args.ledger) if args.ledger else ""):
         if section:
             sections.append(section)
     if args.journal:

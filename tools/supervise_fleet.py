@@ -41,6 +41,17 @@ its AnomalyHook writes <workdir>/health_rank<r>.json; the fleet's
 monitor loop reads those, flags stragglers/skew
 (obs/anomaly.detect_skew), annotates the journal with ``anomaly``
 events, and maintains the aggregate <workdir>/health.json.
+
+Round 12 (run ledger + live scrape): every rank AND the fleet append to
+the run ledger <workdir>/RUNS.jsonl (exported as OBS_LEDGER; --ledger
+overrides, 'none' disables) — per-attempt run rows, bounded metric
+samples, gang rows, and the resume_agreement annotation, queryable with
+``python tools/obs_query.py list|diff --ledger <workdir>/RUNS.jsonl``.
+With ``--http`` each rank gets an OBS_HTTP_PORT export and serves
+/metrics, /health, /flight, /ledger/tail live (obs/serve.py); the
+monitor pass then scrapes /health over HTTP and falls back to the
+per-rank file (the journal's ``health_scrape`` events name the
+transport used).
 """
 
 from __future__ import annotations
@@ -119,6 +130,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="step-time multiple of the other ranks' median "
                         "that marks a laggard as a straggler (its own "
                         "regression flag also qualifies)")
+    p.add_argument("--http", action="store_true",
+                   help="export a per-rank OBS_HTTP_PORT so every rank "
+                        "serves /metrics, /health, /flight, /ledger/tail "
+                        "live (obs/serve.py); the fleet monitor then "
+                        "prefers HTTP /health scrapes over the per-rank "
+                        "file (journal shows which transport it used)")
+    p.add_argument("--ledger", default="",
+                   help="run ledger path exported to every rank as "
+                        "OBS_LEDGER (default <workdir>/RUNS.jsonl; "
+                        "'none' disables the default — an operator's "
+                        "own OBS_LEDGER export still wins, for ranks "
+                        "AND fleet rows alike) — query with "
+                        "tools/obs_query.py list/diff --ledger <path>")
     p.add_argument("--seed", type=int, default=None,
                    help="backoff-jitter seed (tests)")
     args = p.parse_args(argv)
@@ -154,7 +178,9 @@ def main(argv: list[str] | None = None) -> int:
         workdir=workdir,
         health_path=("" if args.health == "none" else args.health or None),
         skew_lag_steps=args.skew_lag_steps,
-        skew_time_ratio=args.skew_time_ratio)
+        skew_time_ratio=args.skew_time_ratio,
+        ledger_path=("" if args.ledger == "none" else args.ledger or None),
+        http=args.http)
     try:
         res = fleet.run(child, name=args.name,
                         snapshot_dir_template=snapshots,
