@@ -11,13 +11,21 @@ Three instruments on one workload (models/transformer_lm.py):
    golden-pinned MFU denominator), never the aggregate cost_analysis
    flops (which lumps in elementwise noise).
 2. **Knob A/B matrix** (``--size``, default lm_base ~57M params): the
-   remat/shard_update/bucket_grads matrix re-run where arXiv:2004.13336
-   actually evaluates — optimizer state + activations in the hundreds
-   of MB — with MEASURED wins: per-device optimizer-state bytes read
-   from the live array shardings (ZeRO-1's 1/D, now against ~229 MB of
-   momentum instead of ResNet-20's HBM-noise) and per-device peak
-   temp/activation bytes from the compiler's own memory analysis
-   (remat's resident-activation diet).
+   remat/shard_update/bucket_grads/zero3 matrix re-run where
+   arXiv:2004.13336 actually evaluates — optimizer state + activations
+   in the hundreds of MB — with MEASURED wins: per-device
+   param+grad+opt residency read from the live array shardings for
+   EVERY config (``utils/profiling.state_residency_per_device`` —
+   ZeRO-1's opt-only 1/D and ZeRO-3's param+opt 1/D against ~458 MB of
+   replicated params+momentum) and per-device peak temp/activation
+   bytes from the compiler's own memory analysis (remat's
+   resident-activation diet; where ZeRO-3's transient gathered params
+   and the 1/D gradient rows live).  The ``zero3`` /
+   ``zero3_nooverlap`` pair times the double-buffered AG-prefetch
+   schedule against the serial-gather control (pure scheduling —
+   bitwise-same math; on the CPU platform the pair only proves both
+   schedules compile and run, the overlap win is the armed TPU
+   prediction).
 3. **Collective inventory** per config (the PR-6 instrument): the
    compiled schedule each knob actually emits.
 
@@ -44,7 +52,8 @@ import sys
 import time
 import traceback
 
-_ALL_KNOBS = ("base", "remat", "shard_update", "bucket", "zero1")
+_ALL_KNOBS = ("base", "remat", "shard_update", "bucket", "zero1",
+              "zero3", "zero3_nooverlap")
 
 
 def _emit(metric: str, value: float, unit: str, detail: dict,
@@ -88,7 +97,9 @@ def optstate_bytes_per_device(opt_state) -> int:
 
 def _build(size: str, mesh, batch_per_chip: int, seq_len: int,
            unroll: int, *, remat: str = "none", shard_update: bool = False,
-           bucket: bool = False, seed: int = 0, split_n: int | None = None):
+           bucket: bool = False, shard_params: bool = False,
+           overlap: bool = True, seed: int = 0,
+           split_n: int | None = None):
     """Dataset + state + jitted step for one knob config — the same
     builders run_training wires (models registry, DeviceDataset
     token_data, make_indexed_train_step, the shard_update/ZeRO-1
@@ -115,14 +126,28 @@ def _build(size: str, mesh, batch_per_chip: int, seq_len: int,
     model = build_model(size, dropout=0.0, remat=remat)
     tx = optax.sgd(0.1, momentum=0.9)
     bucket_bytes = DEFAULT_BUCKET_BYTES if bucket else None
-    bucket_zero1 = bool(bucket_bytes) and shard_update and D > 1
-    if shard_update and not bucket_zero1:
+    zero3_on = shard_params and bool(bucket_bytes) and D > 1
+    bucket_zero1 = bool(bucket_bytes) and shard_update and D > 1 \
+        and not zero3_on
+    if shard_update and not (bucket_zero1 or zero3_on):
         from distributedtensorflowexample_tpu.training.optimizers import (
             cross_replica_update_sharding)
         tx = cross_replica_update_sharding(tx, mesh)
     state = TrainState.create_sharded(
         model, tx, (global_batch, seq_len), seed, replicated_sharding(mesh))
-    if bucket_zero1:
+    zero3_layout = None
+    if zero3_on:
+        from distributedtensorflowexample_tpu.parallel.zero3 import (
+            Zero3Layout)
+        zero3_layout = Zero3Layout(state.params, bucket_bytes, mesh)
+        state = state.replace(opt_state=init_bucketed_opt_state(
+            optax.sgd(0.1, momentum=0.9), state.params,
+            bucket_bytes, mesh))
+        # init_rows DONATES the replicated params: from here on the full
+        # tree exists only as the step's per-bucket gathered temporaries.
+        state = state.replace(
+            params=zero3_layout.init_rows(state.params))
+    elif bucket_zero1:
         state = state.replace(opt_state=init_bucketed_opt_state(
             optax.sgd(0.1, momentum=0.9), state.params,
             bucket_bytes, mesh))
@@ -136,7 +161,8 @@ def _build(size: str, mesh, batch_per_chip: int, seq_len: int,
     step = make_indexed_train_step(
         global_batch, ds.steps_per_epoch, mesh=mesh, unroll_steps=unroll,
         num_slots=ds.num_slots, bucket_bytes=bucket_bytes,
-        bucket_shard_update=bucket_zero1)
+        bucket_shard_update=bucket_zero1, zero3_layout=zero3_layout,
+        zero3_overlap=overlap)
     return step, ds, state, global_batch
 
 
@@ -248,6 +274,10 @@ def run_ab_matrix(args, mesh, platform, lines, errors) -> None:
         "shard_update": {"shard_update": True},
         "bucket": {"bucket": True},
         "zero1": {"bucket": True, "shard_update": True},
+        "zero3": {"bucket": True, "shard_update": True,
+                  "shard_params": True},
+        "zero3_nooverlap": {"bucket": True, "shard_update": True,
+                            "shard_params": True, "overlap": False},
     }
     if D <= 1:
         # No cross-replica redundancy to shard and nothing to bucket on
@@ -269,6 +299,11 @@ def run_ab_matrix(args, mesh, platform, lines, errors) -> None:
                     "global_batch": global_batch,
                     "opt_state_bytes_per_device":
                         optstate_bytes_per_device(state.opt_state),
+                    # Per-device resident param+grad+opt split for EVERY
+                    # config: the zero3 A/B's measured baseline column
+                    # (grads are step-local on every path — they live in
+                    # memory.temp_bytes below).
+                    "residency": audit.get("residency") or {},
                     "memory": audit.get("memory") or {},
                     "collectives": _strip_collectives(
                         (audit.get("collectives") or {})),
@@ -324,6 +359,44 @@ def run_ab_matrix(args, mesh, platform, lines, errors) -> None:
                            "collectives": results[name]["collectives"]
                            .get("multiset", {})},
                           lines)
+        base_res = (base.get("residency") or {}).get(
+            "state_bytes_per_device")
+        if "zero3" in results and base_res:
+            z3 = results["zero3"]
+            z3_res = (z3.get("residency") or {}).get(
+                "state_bytes_per_device")
+            if z3_res:
+                _emit(f"{size}_zero3_state_residency_shrink_x",
+                      base_res / z3_res, "x (1/D ideal = D)",
+                      {**shared,
+                       "state_bytes_per_device_base": base_res,
+                       "state_bytes_per_device_zero3": z3_res,
+                       "residency_base": base.get("residency"),
+                       "residency_zero3": z3.get("residency"),
+                       "temp_bytes_zero3": (z3.get("memory") or {}).get(
+                           "temp_bytes"),
+                       "collectives": z3["collectives"].get("multiset",
+                                                            {}),
+                       "note": "per-device resident params+opt from the "
+                               "live donated-argument shardings (grads "
+                               "are step-local on every path and live "
+                               "in temp_bytes); 1/D ideal = D"}, lines)
+    # Outside the `if base:` gate on purpose: the ratio needs only the
+    # zero3 pair, and the armed next-window capture runs exactly
+    # `--knobs zero3,zero3_nooverlap` with no base column.
+    on = (results.get("zero3") or {}).get("steps_per_sec")
+    off = (results.get("zero3_nooverlap") or {}).get("steps_per_sec")
+    if on and off:
+        _emit(f"{size}_zero3_overlap_speedup_x", on / off,
+              "x (overlap-on over overlap-off wall clock)",
+              {**shared,
+               "steps_per_sec_overlap_on": on,
+               "steps_per_sec_overlap_off": off,
+               "note": "double-buffered AG-prefetch vs serial-gather "
+                       "control; XLA:CPU dispatches synchronously so "
+                       "~1.0x here only proves both schedules "
+                       "compile+run — the overlap win is the armed "
+                       "TPU prediction (BASELINE_SELF.json)"}, lines)
     detail = {**shared, "matrix": results}
     if errors:
         detail["errors"] = dict(errors)
@@ -366,7 +439,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--knobs", default="",
                    help="comma-separated subset of "
                         f"{_ALL_KNOBS} (default: all)")
-    p.add_argument("--ab_timed_knobs", default="base,remat,bucket,zero1",
+    p.add_argument("--ab_timed_knobs",
+                   default="base,remat,bucket,zero1,zero3,zero3_nooverlap",
                    help="configs that also get a measured rate; the "
                         "constraint-form shard_update is compile-only by "
                         "default on the CPU mesh (measured at lm_tiny: "

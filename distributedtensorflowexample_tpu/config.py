@@ -98,6 +98,22 @@ class RunConfig:
                                     # update + all-gather ZeRO-1 schedule.
                                     # Async mode buckets the worker-
                                     # average psums.  No BatchNorm models
+    shard_params: bool = False      # ZeRO-3/FSDP (parallel/zero3.py):
+                                    # params AND grads live as 1/D
+                                    # bucket rows; each bucket's params
+                                    # all-gathered just before use and
+                                    # freed after, grads reduce-
+                                    # scattered per bucket by the
+                                    # gather's transpose.  Requires
+                                    # --bucket_grads (the row layout);
+                                    # sync mode only; no BN models
+    zero3_overlap: bool = True      # --shard_params gather schedule:
+                                    # true = double-buffered prefetch
+                                    # (bucket i+1's all-gather issues
+                                    # while bucket i's compute runs);
+                                    # false = strictly serial gathers
+                                    # (the A/B control bench_lm times).
+                                    # Pure scheduling — bitwise-same
 
     # --- hand-written TPU kernels (ops/pallas) ---
     pallas_ce: bool = False         # fused Pallas loss head in the train step
@@ -229,6 +245,24 @@ _FLAG_HELP = {
                     "schedule; in async mode buckets the worker-average "
                     "psums. Refused by name for BatchNorm models and "
                     "--fused_optimizer",
+    "shard_params": "ZeRO-3/FSDP full param+grad sharding "
+                    "(arXiv:2004.13336 stage 3): params and grads live "
+                    "resident as 1/D bucket rows, each bucket's params "
+                    "all-gathered just before its layer consumes them "
+                    "(double-buffered prefetch — see --zero3_overlap) "
+                    "and freed after last use, grads reduce-scattered "
+                    "per bucket, the 1/D update written straight back "
+                    "(no step-closing all-gather). Per-device "
+                    "param+grad+opt residency ~1/D. Requires "
+                    "--bucket_grads; sync mode only; changes the "
+                    "checkpoint layout (zero3_rows — cross-layout and "
+                    "cross-mesh-size resume refused by name)",
+    "zero3_overlap": "with --shard_params: true (default) issues bucket "
+                     "i+1's all-gather while bucket i's compute runs "
+                     "(at most two gathered buckets in flight — the "
+                     "double buffer); false chains the gathers strictly "
+                     "serially. Scheduling only, bitwise-identical "
+                     "results — the overlap A/B bench_lm.py measures",
     "pallas_ce": "fused Pallas cross-entropy head",
     "fused_optimizer": "fused Pallas momentum-SGD (measured 2.3x slower "
                        "than XLA on v5e — kept as kernel reference; "

@@ -36,6 +36,14 @@ Two modes, selected by ``--shard_update``:
   all-gather) pairs at unchanged total reduction bytes (+ padding to
   multiples of D, reported by ``plan_buckets``).
 
+The bucket-row machinery here — ``plan_buckets`` (static, order-
+preserving membership), ``_rows2d``/``_bucket_flat2d``/``_unbucket_rows``
+(the ``[D, ceil(n/D)]`` layout and its inverse), padding accounting, and
+``init_bucketed_opt_state`` (optimizer moments AS rows) — is also the
+resident layout of the ZeRO-3 step (parallel/zero3.py): same plan, same
+rows, with the params themselves joining the optimizer state in 1/D
+residency and the all-gather moving to the forward as a prefetch.
+
 Parity contract (the remat/shard_update template): bucketing itself is
 bitwise — any two bucket sizes produce identical results (same elementwise
 additions, regrouped).  Against the GSPMD default the shard_map backward

@@ -338,7 +338,8 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
                    mesh=None, num_replicas: int = 1,
                    replicas_to_aggregate: int = 0,
                    bucket_bytes: int | None = None,
-                   bucket_shard_update: bool = False) -> Callable:
+                   bucket_shard_update: bool = False,
+                   zero3_layout=None, zero3_overlap: bool = True) -> Callable:
     """The un-jitted (state, batch) -> (state, metrics) step body, shared
     by the plain and the device-resident (indexed) step factories.
 
@@ -369,7 +370,22 @@ def _build_step_fn(label_smoothing: float = 0.0, ce_impl: str = "xla",
     sharded-update + all-gather ZeRO-1 schedule.  On a single-device
     mesh there is nothing to reduce, so the knob falls through to this
     plain body.
+
+    ``zero3_layout`` (the ``--shard_params`` knob, parallel/zero3.py)
+    goes one stage further: params AND grads live as 1/D bucket rows,
+    each bucket's params all-gathered just before use (double-buffered
+    prefetch unless ``zero3_overlap`` is off) and reduce-scattered in
+    the backward by the gather's own transpose.  Takes precedence over
+    the ZeRO-1 schedule (it subsumes it); same single-device
+    fall-through.
     """
+    if zero3_layout is not None and mesh is not None \
+            and mesh.shape[DATA_AXIS] > 1:
+        from distributedtensorflowexample_tpu.parallel.zero3 import (
+            build_zero3_step_fn)
+        return build_zero3_step_fn(label_smoothing, ce_impl, mesh,
+                                   num_replicas, replicas_to_aggregate,
+                                   zero3_layout, overlap=zero3_overlap)
     if bucket_bytes and mesh is not None and mesh.shape[DATA_AXIS] > 1:
         from distributedtensorflowexample_tpu.parallel.bucketing import (
             build_bucketed_step_fn)
@@ -464,7 +480,9 @@ def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
                     dequant_impl: str = "auto",
                     quantize: str = "auto",
                     bucket_bytes: int | None = None,
-                    bucket_shard_update: bool = False) -> Callable:
+                    bucket_shard_update: bool = False,
+                    zero3_layout=None,
+                    zero3_overlap: bool = True) -> Callable:
     """Build the jitted (state, batch) -> (state, metrics) step.
 
     ``dequant``: spec for HOST-FED uint8 batches (``batcher.dequant``);
@@ -472,11 +490,14 @@ def make_train_step(label_smoothing: float = 0.0, ce_impl: str = "xla",
     ``dequant_impl``/``quantize``: the in-step dequant kernel knobs (same
     resolution rule as the resident path — see ``dequant_host_batch``).
     ``bucket_bytes``/``bucket_shard_update``: the ``--bucket_grads``
-    collective schedule (see ``_build_step_fn``)."""
+    collective schedule; ``zero3_layout``/``zero3_overlap``: the
+    ``--shard_params`` ZeRO-3 schedule (see ``_build_step_fn``)."""
     inner = _build_step_fn(label_smoothing, ce_impl, mesh,
                            num_replicas, replicas_to_aggregate,
                            bucket_bytes=bucket_bytes,
-                           bucket_shard_update=bucket_shard_update)
+                           bucket_shard_update=bucket_shard_update,
+                           zero3_layout=zero3_layout,
+                           zero3_overlap=zero3_overlap)
 
     def step(state: TrainState, batch):
         return inner(state, dequant_host_batch(batch, dequant, dequant_impl,
@@ -495,7 +516,9 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
                             data_sharding: str = "replicated",
                             dequant_impl: str = "auto",
                             bucket_bytes: int | None = None,
-                            bucket_shard_update: bool = False) -> Callable:
+                            bucket_shard_update: bool = False,
+                            zero3_layout=None,
+                            zero3_overlap: bool = True) -> Callable:
     """Step over a device-resident dataset (see ``data.DeviceDataset``).
 
     The batch is GATHERED ON DEVICE from the resident split: the step
@@ -528,7 +551,9 @@ def make_indexed_train_step(batch_size: int, steps_per_epoch: int,
     inner = _build_step_fn(label_smoothing, ce_impl, mesh, num_replicas,
                            replicas_to_aggregate,
                            bucket_bytes=bucket_bytes,
-                           bucket_shard_update=bucket_shard_update)
+                           bucket_shard_update=bucket_shard_update,
+                           zero3_layout=zero3_layout,
+                           zero3_overlap=zero3_overlap)
     gather = make_device_gather(batch_size, steps_per_epoch, augment, mesh,
                                 num_slots=num_slots,
                                 data_sharding=data_sharding,

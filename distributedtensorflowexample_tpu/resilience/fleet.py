@@ -795,18 +795,61 @@ class FleetSupervisor:
                 time.sleep(self.poll_s)
 
     # --- resume-step agreement --------------------------------------------
+    def _snapshot_dirs(self, snapshot_dir_template: str) -> dict:
+        return {r: snapshot_dir_template.replace("{rank}", str(r))
+                for r in self.ranks}
+
+    def _discard_all(self, name: str, dirs: dict, agreed: int) -> dict:
+        """``discard_newer(agreed)`` on every rank's store — the
+        mutation half of the agreement, journaled write-ahead by the
+        caller so a supervisor death ANYWHERE in this loop is
+        recoverable (:meth:`_replay_agreement` re-applies it; the
+        per-store discard is itself idempotent: it only ever removes
+        steps > agreed, which a second pass finds already gone).
+
+        ``FLEET_DRILL_DIE_IN_DISCARD=<k>`` is the interrupted-AGREEMENT
+        drill seam (ROADMAP fault library): the supervisor "dies"
+        (raises) after discarding the k-th rank's store, leaving later
+        ranks still holding their divergent newer snapshots — exactly
+        the half-discarded state a mid-discard crash leaves, which the
+        journal replay must heal before any child resumes."""
+        from distributedtensorflowexample_tpu.resilience import (
+            snapshot as snap)
+        die_after = os.environ.get("FLEET_DRILL_DIE_IN_DISCARD", "")
+        discarded = {}
+        for i, r in enumerate(sorted(dirs)):
+            discarded[r] = snap.SnapshotStore(dirs[r]).discard_newer(
+                agreed)
+            if die_after and i == int(die_after):
+                raise RuntimeError(
+                    f"FLEET_DRILL_DIE_IN_DISCARD={die_after}: "
+                    f"{name}: supervisor dying mid-discard (rank "
+                    f"{r} done, later ranks untouched)")
+        return discarded
+
     def _agree(self, name: str, snapshot_dir_template: str) -> int | None:
         """The agreement pass: max common valid step across every
-        surviving rank's store, divergent/torn newest steps discarded
+        surviving rank's store, divergent/torn newer steps discarded
         from disk, result journaled — returns the step to export (0 =
         no common step: fresh start), or None when the run has no
-        snapshot surface to agree over."""
+        snapshot surface to agree over.
+
+        The ``resume_agreement`` record is WRITE-AHEAD: it commits the
+        agreed step (and what will be discarded) to the journal BEFORE
+        any store is mutated, and ``resume_discard_done`` commits
+        completion after.  A supervisor that dies between the two left
+        a half-discarded fleet; a restarted supervisor's
+        :meth:`_replay_agreement` finds the unmatched intent record and
+        re-applies the discard — without the replay, its FIRST launch
+        exports no agreed step and every child restores its own newest,
+        so the ranks the dead supervisor never reached would silently
+        resume the divergent timeline the agreement had already
+        condemned."""
         if not snapshot_dir_template:
             return None
         from distributedtensorflowexample_tpu.resilience import (
             snapshot as snap)
-        dirs = {r: snapshot_dir_template.replace("{rank}", str(r))
-                for r in self.ranks}
+        dirs = self._snapshot_dirs(snapshot_dir_template)
         # One validation pass (full payload read + crc32 per snapshot)
         # serves both the journal detail and the intersection — this is
         # newest_common_step's exact rule computed from the per-rank
@@ -814,13 +857,20 @@ class FleetSupervisor:
         per_rank = {r: snap.valid_steps(d) for r, d in dirs.items()}
         common = set.intersection(*(set(v) for v in per_rank.values()))
         agreed = max(common) if common else 0
-        discarded = {r: snap.SnapshotStore(d).discard_newer(agreed)
-                     for r, d in dirs.items()}
-        _AGREEMENTS.inc()
+        # The record's "discarded" is the write-ahead PLAN (valid steps
+        # the agreement condemns); the actual sweep — which also drops
+        # torn newer payloads per_rank never listed — lands in the
+        # resume_discard_done completion record.
         self.journal.write(
             "resume_agreement", task=name, agreed=agreed,
             per_rank={str(r): v for r, v in per_rank.items()},
+            discarded={str(r): [s for s in v if s > agreed]
+                       for r, v in per_rank.items()})
+        discarded = self._discard_all(name, dirs, agreed)
+        self.journal.write(
+            "resume_discard_done", task=name, agreed=agreed,
             discarded={str(r): v for r, v in discarded.items()})
+        _AGREEMENTS.inc()
         # The same agreement lands in the run ledger: obs_query renders
         # it between the attempts it separates, so "what did the gang
         # agree to resume from" is answerable without the journal.
@@ -834,6 +884,44 @@ class FleetSupervisor:
              + f" -> agreed step {agreed}"
              + (f" (discarded {discarded})" if any(discarded.values())
                 else ""))
+        return agreed
+
+    def _replay_agreement(self, name: str,
+                          snapshot_dir_template: str) -> int | None:
+        """Journal replay of an INTERRUPTED discard: the newest
+        ``resume_agreement`` record with no ``resume_discard_done``
+        after it means a previous supervisor incarnation died
+        mid-:meth:`_discard_all`.  Re-apply the discard (idempotent —
+        already-trimmed stores lose nothing) and return the agreed step
+        so the first launch exports it; a COMPLETED prior agreement (or
+        none at all) returns None and the first launch keeps its normal
+        nothing-to-agree-on semantics."""
+        if not snapshot_dir_template:
+            return None
+        pending = None
+        for rec in self.journal.events():
+            if rec.get("event") == "resume_agreement" \
+                    and rec.get("task") == name:
+                pending = rec
+            elif rec.get("event") == "resume_discard_done" \
+                    and rec.get("task") == name:
+                pending = None
+        if pending is None:
+            return None
+        agreed = int(pending.get("agreed", 0))
+        dirs = self._snapshot_dirs(snapshot_dir_template)
+        discarded = self._discard_all(name, dirs, agreed)
+        self.journal.write(
+            "resume_discard_done", task=name, agreed=agreed, replayed=True,
+            discarded={str(r): v for r, v in discarded.items()})
+        self._ledger_event(
+            "resume_agreement_replayed", task=name, agreed=agreed,
+            discarded={str(r): v for r, v in discarded.items()})
+        _log(f"{name}: replayed interrupted resume-step agreement "
+             f"(agreed step {agreed}; a prior supervisor died "
+             f"mid-discard"
+             + (f"; discarded {discarded})" if any(discarded.values())
+                else ")"))
         return agreed
 
     # --- the gang retry loop ----------------------------------------------
@@ -851,7 +939,14 @@ class FleetSupervisor:
         failures = 0
         preemptions = 0
         restarts = 0
-        agreed: int | None = None
+        # A prior supervisor incarnation that died mid-discard left the
+        # fleet half-trimmed; replaying the journaled intent BEFORE the
+        # first launch re-applies the discard (idempotent) and pins the
+        # first gang to the already-agreed step — otherwise children
+        # with no export would restore their own newest, resuming the
+        # divergent timeline the dead supervisor had condemned.
+        agreed: int | None = self._replay_agreement(
+            name, snapshot_dir_template)
         agreed_steps: list = []
         reasons: list[str] = []
         last: dict = {}

@@ -187,25 +187,33 @@ class Journal:
             f.flush()
             os.fsync(f.fileno())
 
-    def replay(self) -> dict:
-        """{"done": set[str], "wedged": bool} from prior runs; torn tail
-        lines (the journal itself can die mid-write) are skipped, not
-        fatal — the cost is re-running the task whose completion record
-        tore, which is idempotent-by-design for every capture phase."""
-        done: set[str] = set()
-        wedged = False
+    def events(self) -> list[dict]:
+        """Every parseable record, in write order; torn lines skipped
+        (the journal itself can die mid-write) — the shared read for
+        :meth:`replay` and the fleet's agreement-replay pass."""
+        out: list[dict] = []
         if not self._path or not os.path.exists(self._path):
-            return {"done": done, "wedged": wedged}
+            return out
         with open(self._path) as f:
             for line in f:
                 try:
-                    rec = json.loads(line)
+                    out.append(json.loads(line))
                 except json.JSONDecodeError:
                     continue
-                if rec.get("event") == "task_done":
-                    done.add(rec.get("task", ""))
-                elif rec.get("event") == "chip_wedged":
-                    wedged = True
+        return out
+
+    def replay(self) -> dict:
+        """{"done": set[str], "wedged": bool} from prior runs; torn tail
+        lines are skipped, not fatal — the cost is re-running the task
+        whose completion record tore, which is idempotent-by-design for
+        every capture phase."""
+        done: set[str] = set()
+        wedged = False
+        for rec in self.events():
+            if rec.get("event") == "task_done":
+                done.add(rec.get("task", ""))
+            elif rec.get("event") == "chip_wedged":
+                wedged = True
         return {"done": done, "wedged": wedged}
 
 

@@ -131,18 +131,20 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
             f"{saved_layout!r} optimizer state; this run uses "
             f"{current['update_layout']!r} (--bucket_grads with "
             f"--shard_update stores per-bucket flat rows instead of the "
-            f"params-shaped tree). Resume with the writing run's knobs "
-            f"or start fresh with a new --log_dir")
-    if (saved_layout == "bucket_rows"
+            f"params-shaped tree; --shard_params stores the PARAMS as "
+            f"rows too — zero3_rows). Resume with the writing run's "
+            f"knobs or start fresh with a new --log_dir")
+    if (saved_layout.endswith("_rows")
             and saved.get("mesh_size") is not None
             and saved["mesh_size"] != current["mesh_size"]):
         # Bucket rows are a function of D ([D, ceil(n/D)] layout +
         # padding): a different mesh size is at best an unnamed Orbax
         # shape error and at worst — when the padded totals happen to
-        # match — a silently PERMUTED momentum restore.
+        # match — a silently PERMUTED momentum (or, for zero3_rows,
+        # PARAM) restore.
         raise ValueError(
-            f"checkpoint in {log_dir}/checkpoints holds bucket_rows "
-            f"optimizer state laid out for mesh_size="
+            f"checkpoint in {log_dir}/checkpoints holds {saved_layout} "
+            f"state laid out for mesh_size="
             f"{saved['mesh_size']}; this run has mesh_size="
             f"{current['mesh_size']} — the 1/D row layout is structural. "
             f"Resume on {saved['mesh_size']} devices or start fresh "
@@ -279,12 +281,29 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             "--bucket_grads restructures the gradient reduction around "
             "the optimizer apply; the Pallas fused apply is a custom "
             "call with its own layout contract — use one or the other")
+    if cfg.shard_params and cfg.sync_mode != "sync":
+        raise ValueError(
+            "--shard_params shards the sync data-parallel step's params "
+            "across the mesh; async mode's state is worker-tiled (each "
+            "device already owns its workers' whole copy) — there is no "
+            "cross-replica redundancy to shard away")
+    if cfg.shard_params and not bucket_bytes:
+        raise ValueError(
+            "--shard_params lays params out in the knee-sized "
+            "dtype-homogeneous bucket rows; pass --bucket_grads (auto, "
+            "or a byte cap) to size them")
+    # ZeRO-3 (--shard_params, parallel/zero3.py) subsumes the ZeRO-1
+    # bucket schedule: params, grads AND optimizer state all live as 1/D
+    # bucket rows.  On a 1-device mesh there is nothing to shard and the
+    # plain step is used as-is (same fall-through as ZeRO-1 below).
+    zero3_on = cfg.shard_params and bool(bucket_bytes) \
+        and num_replicas > 1 and cfg.sync_mode == "sync"
     # The explicit per-bucket ZeRO-1 schedule replaces the GSPMD
     # constraint form of --shard_update (see parallel/bucketing.py);
     # on a 1-device mesh there is nothing to reduce and the plain step
     # (with the constraint wrapper's 1-extent no-op) is used as-is.
     bucket_zero1 = bool(bucket_bytes) and cfg.shard_update \
-        and num_replicas > 1 and cfg.sync_mode == "sync"
+        and num_replicas > 1 and cfg.sync_mode == "sync" and not zero3_on
 
     train_x, train_y = _load_dataset(cfg, dataset_name, "train")
     test_x, test_y = _load_dataset(cfg, dataset_name, "test")
@@ -310,7 +329,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     model = build_model(model_name, dropout=cfg.dropout,
                         dtype=jnp.dtype(cfg.dtype), remat=cfg.remat)
     tx = build_optimizer(cfg, mesh=mesh,
-                         wrap_shard_update=not bucket_zero1)
+                         wrap_shard_update=not (bucket_zero1 or zero3_on))
     # Sample shape comes from the loaded split itself (images: [N,H,W,C],
     # tokens: [N,T]) — _SAMPLE_SHAPES stays as documentation of the
     # image families' shapes.
@@ -325,7 +344,22 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             f"per-shard statistics (a different model, not a different "
             f"collective schedule). Use the default fused all-reduce "
             f"for BatchNorm models")
-    if bucket_zero1:
+    zero3_layout = None
+    if zero3_on:
+        # ZeRO-3 resident layout (parallel/zero3.py): optimizer state
+        # first (it reads the full params), then the params themselves
+        # become 1/D bucket rows — init_rows DONATES the replicated
+        # tree, so full params stop being resident right here and the
+        # step's donation aliases the rows from call one.
+        from distributedtensorflowexample_tpu.parallel.bucketing import (
+            init_bucketed_opt_state)
+        from distributedtensorflowexample_tpu.parallel.zero3 import (
+            Zero3Layout)
+        zero3_layout = Zero3Layout(state.params, bucket_bytes, mesh)
+        state = state.replace(opt_state=init_bucketed_opt_state(
+            tx, state.params, bucket_bytes, mesh))
+        state = state.replace(params=zero3_layout.init_rows(state.params))
+    elif bucket_zero1:
         # The bucketed ZeRO-1 step keeps optimizer state as per-bucket
         # flat rows (1/D per device) — replace the params-shaped state
         # create_sharded laid out with that working layout so donation
@@ -368,10 +402,14 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     run_meta = {"sync_mode": cfg.sync_mode, "mesh_size": num_replicas,
                 "num_workers": num_replicas if is_async else None,
                 # bucket_rows: optimizer state stored as per-bucket flat
-                # 1/D rows (the bucketed ZeRO-1 schedule) — structurally
-                # different from the params-shaped tree layout, so a
-                # cross-layout resume must be refused by name.
-                "update_layout": "bucket_rows" if bucket_zero1 else "tree"}
+                # 1/D rows (the bucketed ZeRO-1 schedule); zero3_rows:
+                # params AND optimizer state stored as rows (ZeRO-3) —
+                # both structurally different from the params-shaped
+                # tree layout, so a cross-layout resume must be refused
+                # by name.
+                "update_layout": ("zero3_rows" if zero3_on else
+                                  "bucket_rows" if bucket_zero1 else
+                                  "tree")}
     if cfg.checkpoint_every > 0 or cfg.resume:
         manager = CheckpointManager(f"{cfg.log_dir}/checkpoints",
                                     max_to_keep=cfg.keep_checkpoints,
@@ -401,6 +439,13 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         _evaluate = functools.partial(evaluate, images=test_x, labels=test_y,
                                       batch_size=eval_batch,
                                       sharding=data_shard)
+    if zero3_on:
+        # Eval consumes the full tree; gather the 1/D rows back once per
+        # eval (jitted+cached per layout — a transient full copy, like
+        # the forward's own gathered temporaries).
+        _row_eval = _evaluate
+        _evaluate = lambda s: _row_eval(
+            s.replace(params=zero3_layout.materialize(s.params)))
     # Async state carries per-worker copies; eval on their average.
     eval_fn = (lambda s: _evaluate(consolidate(s))) if is_async else _evaluate
     if cfg.eval_every > 0:
@@ -479,7 +524,8 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
             replicas_to_aggregate=cfg.replicas_to_aggregate,
             num_slots=ds.num_slots, data_sharding=cfg.data_sharding,
             dequant_impl=cfg.dequant_impl, bucket_bytes=bucket_bytes,
-            bucket_shard_update=bucket_zero1)
+            bucket_shard_update=bucket_zero1,
+            zero3_layout=zero3_layout, zero3_overlap=cfg.zero3_overlap)
     else:
         train_step = make_train_step(cfg.label_smoothing, ce_impl=ce_impl,
                                      mesh=mesh, num_replicas=num_replicas,
@@ -488,7 +534,9 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                                      dequant_impl=cfg.dequant_impl,
                                      quantize=cfg.quantize,
                                      bucket_bytes=bucket_bytes,
-                                     bucket_shard_update=bucket_zero1)
+                                     bucket_shard_update=bucket_zero1,
+                                     zero3_layout=zero3_layout,
+                                     zero3_overlap=cfg.zero3_overlap)
     # Preemption safety (TPU-first failure recovery, SURVEY §5): the
     # platform sends SIGTERM before reclaiming a slice/VM.  The handler
     # only SETS A FLAG — the loop polls it at call boundaries and stops

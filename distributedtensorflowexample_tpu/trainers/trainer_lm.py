@@ -3,15 +3,23 @@
 A decoder-only LM (models/transformer_lm.py) on the deterministic
 synthetic token corpus (data/lm.py), run through the SAME shared trainer
 runner as every reference config — so sync, async-PS emulation,
-``--remat block``, ``--shard_update``, ``--bucket_grads``, device-
-resident (uint8 token) data, checkpoints, supervision, and telemetry
-all apply unchanged.  BN-free by construction: the bucketing/ZeRO-1
-BatchNorm refusals never trigger.
+``--remat block``, ``--shard_update``, ``--bucket_grads``,
+``--shard_params`` (ZeRO-3), device-resident (uint8 token) data,
+checkpoints, supervision, and telemetry all apply unchanged.  BN-free
+by construction: the bucketing/ZeRO BatchNorm refusals never trigger.
 
   python -m distributedtensorflowexample_tpu.trainers.trainer_lm \
       --size lm_tiny --train_steps 600
   python -m ...trainer_lm --size lm_base --shard_update true \
       --bucket_grads auto --remat block      # the knobs, where they bind
+  python -m ...trainer_lm --size lm_base --shard_params true \
+      --bucket_grads auto                    # ZeRO-3: params+grads+opt
+                                             # resident 1/D per device,
+                                             # double-buffered per-bucket
+                                             # all-gather prefetch; NOTE
+                                             # the checkpoint layout
+                                             # becomes zero3_rows (resume
+                                             # needs the same knobs+D)
 
 ``--size`` selects the ladder rung (lm_tiny | lm_small | lm_base —
 models.LM_SIZES); everything else is the standard flag surface.

@@ -323,6 +323,107 @@ def test_bucketed_zero1_golden_inventory_and_bitwise_parity():
                  s_ref.params, s_z.params)
 
 
+def test_zero3_golden_inventory_prefetch_order_and_bitwise_parity():
+    """--shard_params on softmax (PR 12): the ZeRO-3 schedule — the
+    whole tree fits ONE knee-sized bucket, so per step ONE param
+    all-gather in the FORWARD (prefetch: it textually precedes the
+    reduce-scatter in the compiled module, where ZeRO-1's
+    update-closing AG follows its RS), ONE reduce-scatter placed by the
+    gather's transpose in the backward, the fused metrics pair — and NO
+    step-closing all-gather (the updated 1/D row writes straight back).
+    Reduction bytes conserved up to the reported row padding; parity vs
+    the GSPMD default is BITWISE including metrics (the ZeRO-1
+    standard), and both params and opt state live as 1/D rows."""
+    from distributedtensorflowexample_tpu.parallel.zero3 import Zero3Layout
+    mesh = make_mesh()
+    D = mesh.size
+    x, y = _data()
+    mk = lambda: DeviceDataset(x, y, 64, mesh=mesh, seed=4)
+    mk_tx = lambda: optax.sgd(0.1, momentum=0.9)
+    ds = mk()
+    ref = make_indexed_train_step(64, ds.steps_per_epoch, mesh=mesh,
+                                  num_slots=ds.num_slots)
+    s_ref = _state(build_model("softmax"), mk_tx())
+    s_z = _state(build_model("softmax"), mk_tx())
+    pleaves = jax.tree.leaves(s_ref.params)
+    padded = sum(l.size for l in pleaves) * 4 + bucket_padding_bytes(
+        pleaves, D)
+    layout = Zero3Layout(s_z.params, DEFAULT_BUCKET_BYTES, mesh)
+    z3 = make_indexed_train_step(64, ds.steps_per_epoch, mesh=mesh,
+                                 num_slots=ds.num_slots,
+                                 zero3_layout=layout)
+    s_z = s_z.replace(opt_state=init_bucketed_opt_state(
+        mk_tx(), s_z.params, DEFAULT_BUCKET_BYTES, mesh))
+    s_z = s_z.replace(params=layout.init_rows(s_z.params))
+    # ZeRO-3 residency: params AND opt moments are 1/D rows.
+    for leaf in list(s_z.params) + [l for l in jax.tree.leaves(
+            s_z.opt_state) if getattr(l, "ndim", 0)]:
+        assert not leaf.sharding.is_fully_replicated
+    assert sum(r.size for r in s_z.params) * 4 == padded
+    with mesh:
+        compiled = z3.lower(s_z, ds.peek()).compile()
+        inv = collective_inventory(compiled.as_text())
+        ds_r, ds_z = mk(), mk()
+        for _ in range(3):
+            s_ref, m_ref = ref(s_ref, next(ds_r))
+            s_z, m_z = z3(s_z, next(ds_z))
+    assert inv["multiset"] == {"all-gather": 1, "all-reduce": 2,
+                               "reduce-scatter": 1}
+    per = inv["per_step"]
+    assert per["all-gather"]["out_bytes"] == padded
+    assert per["reduce-scatter"]["out_bytes"] == padded // D
+    assert per["all-reduce"]["out_bytes"] == 8          # the metrics pair
+    # The AG-prefetch pin: HLO prints computations in topological order,
+    # and the zero3 module's param gather precedes the backward's RS —
+    # ZeRO-1's module (pinned above) has the opposite order (its AG
+    # closes the update).
+    hlo = compiled.as_text()
+    assert hlo.index("all-gather") < hlo.index("reduce-scatter")
+    assert float(m_ref["loss"]) == float(m_z["loss"])
+    assert float(m_ref["accuracy"]) == float(m_z["accuracy"])
+    full = layout.materialize(s_z.params)
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(c)), s_ref.params, full)
+
+
+def test_zero3_lm_tiny_multi_bucket_golden_inventory():
+    """The per-bucket schedule at lm_tiny: a sub-knee bucket cap splits
+    the tree into several buckets — the compiled module carries exactly
+    one AG + one RS PER BUCKET (the prefetch ladder bench_lm measures
+    at lm_base), metrics on the fused pair, gradient reduction bytes
+    conserved up to the row padding."""
+    from distributedtensorflowexample_tpu.data.lm import load_lm
+    from distributedtensorflowexample_tpu.parallel.zero3 import Zero3Layout
+    mesh = make_mesh()
+    D = mesh.size
+    x, y = load_lm("", "train", num=128, seq_len=16, seed=0)
+    mk_tx = lambda: optax.sgd(0.1, momentum=0.9)
+    ds = DeviceDataset(x, y, 32, mesh=mesh, seed=0, token_data=True)
+    state = TrainState.create_sharded(
+        build_model("lm_tiny"), mk_tx(), (32, 16), 0,
+        replicated_sharding(mesh))
+    bb = 64 << 10
+    layout = Zero3Layout(state.params, bb, mesh)
+    assert layout.num_buckets >= 3       # a real multi-bucket ladder
+    z3 = make_indexed_train_step(32, ds.steps_per_epoch, mesh=mesh,
+                                 num_slots=ds.num_slots,
+                                 zero3_layout=layout)
+    s_z = state.replace(opt_state=init_bucketed_opt_state(
+        mk_tx(), state.params, bb, mesh))
+    s_z = s_z.replace(params=layout.init_rows(s_z.params))
+    with mesh:
+        inv = collective_inventory_of(z3, (s_z, ds.peek()))
+    n = layout.num_buckets
+    assert inv["multiset"] == {"all-gather": n, "all-reduce": 2,
+                               "reduce-scatter": n}
+    pleaves = layout.leaf_specs
+    padded = sum(l.size * l.dtype.itemsize for l in pleaves) \
+        + bucket_padding_bytes(pleaves, D)
+    per = inv["per_step"]
+    assert per["all-gather"]["out_bytes"] == padded
+    assert per["reduce-scatter"]["out_bytes"] == padded // D
+
+
 @pytest.mark.lm
 def test_lm_golden_inventory():
     """The transformer-LM trainer's golden multisets (the third trainer
@@ -497,6 +598,17 @@ def test_bucket_rows_restore_refusals():
     _refuse_incompatible_restore(
         {"sync_mode": "sync", "mesh_size": 4, "update_layout": "tree"},
         cur_t, "/l", False)
+    # zero3_rows (PR 12): params themselves are 1/D rows — the same
+    # structural refusals, by the layout's name
+    cur_z = dict(cur, update_layout="zero3_rows")
+    with pytest.raises(ValueError, match="zero3_rows"):
+        _refuse_incompatible_restore(
+            {"sync_mode": "sync", "mesh_size": 8, "update_layout": "tree"},
+            cur_z, "/l", True)
+    with pytest.raises(ValueError, match="structural"):
+        _refuse_incompatible_restore(
+            {"sync_mode": "sync", "mesh_size": 4,
+             "update_layout": "zero3_rows"}, cur_z, "/l", True)
 
 
 def test_plan_buckets_and_padding():

@@ -328,6 +328,52 @@ print(os.environ["FLEET_RESUME_STEP"])
     assert agree["discarded"]["0"] == [5]
 
 
+def test_interrupted_agreement_discard_replayed_idempotently(
+        tmp_path, monkeypatch):
+    """The ROADMAP fault-library straggler: the supervisor dies
+    MID-``discard_newer`` — rank 0's divergent snapshots already swept,
+    rank 1's untouched (the ``FLEET_DRILL_DIE_IN_DISCARD`` seam).  The
+    write-ahead ``resume_agreement`` record lets a restarted supervisor
+    replay the discard BEFORE its first launch: rank 1's
+    abandoned-timeline snapshot is gone, the first gang already exports
+    the agreed step (no per-rank own-newest restores), and the replay
+    is idempotent — the already-swept rank loses nothing, and a third
+    incarnation (completion record present) replays nothing at all."""
+    snaps = {r: str(tmp_path / f"rank{r}" / "snapshots") for r in (0, 1)}
+    for s in (3, 4, 5):
+        _write_snap(snaps[0], s)
+    for s in (3, 4, 6):
+        _write_snap(snaps[1], s)
+    tmpl = str(tmp_path / "rank{rank}" / "snapshots")
+    monkeypatch.setenv("FLEET_DRILL_DIE_IN_DISCARD", "0")
+    with pytest.raises(RuntimeError, match="mid-discard"):
+        _fleet(tmp_path)._agree("agree", tmpl)
+    assert valid_steps(snaps[0]) == [3, 4]      # swept before the death
+    assert valid_steps(snaps[1]) == [3, 4, 6]   # divergent survivor
+    monkeypatch.delenv("FLEET_DRILL_DIE_IN_DISCARD")
+    # Restarted supervisor, same journal: the interrupted intent must
+    # replay before any child launches.
+    argv = _child(tmp_path, """
+import os
+print(os.environ["FLEET_RESUME_STEP"])
+""")
+    res = _fleet(tmp_path).run(argv, name="agree",
+                               snapshot_dir_template=tmpl,
+                               stdout_dir=str(tmp_path / "out"))
+    assert res.status == "ok" and res.gang_attempts == 1
+    for r in (0, 1):
+        assert valid_steps(snaps[r]) == [3, 4]
+        out = (tmp_path / "out" / f"rank{r}_attempt0.out").read_text()
+        assert out.strip() == "4"               # pinned to the agreement
+    done = [e for e in _journal_events(tmp_path)
+            if e["event"] == "resume_discard_done"]
+    assert done and done[-1].get("replayed") is True
+    assert done[-1]["discarded"] == {"0": [], "1": [6]}  # idempotent half
+    # Completion record present -> a third incarnation replays nothing.
+    assert _fleet(tmp_path, workdir=str(tmp_path / "f2"))\
+        ._replay_agreement("agree", tmpl) is None
+
+
 def test_supervise_fleet_cli_exhausted_never_exits_143(tmp_path,
                                                        monkeypatch):
     """An exhausted fleet whose final attempt happened to contain a

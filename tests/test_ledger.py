@@ -517,6 +517,33 @@ def test_bench_ratchet_trajectory_rows_and_checked_in_artifact(tmp_path):
     assert checked_in == repo_rows
 
 
+def test_bench_ratchet_recognizes_zero3_lm_rows():
+    """PR 12: the zero3 bench rows ride the lm family like any other —
+    the checked-in BENCH_lm_cpu_r12.json parses into metric records
+    (the residency-shrink line and the overlap wall-clock pair), and
+    the regenerated trajectory's lm r12 row carries them, so the
+    ratchet compares them across rounds exactly like the r08 columns
+    (the byte-identical-regeneration gate above covers determinism)."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import bench_ratchet
+    finally:
+        sys.path.remove(TOOLS)
+    recs = bench_ratchet.load_records(
+        [os.path.join(REPO, "BENCH_lm_cpu_r12.json")])
+    metrics = {r["metric"]: r for r in recs}
+    assert "lm_base_zero3_state_residency_shrink_x" in metrics
+    assert "lm_base_zero3_overlap_speedup_x" in metrics
+    shrink = metrics["lm_base_zero3_state_residency_shrink_x"]
+    assert shrink["value"] == 4.0          # 1/D at D=4, measured
+    assert shrink["detail"]["state_bytes_per_device_zero3"] * 4 == \
+        shrink["detail"]["state_bytes_per_device_base"]
+    row = next(r for r in bench_ratchet.build_trajectory(REPO)
+               if r["family"] == "BENCH_lm_cpu" and r["round"] == 12)
+    assert "lm_base_zero3_state_residency_shrink_x" in row["metrics"]
+    assert "lm_base_zero3_overlap_speedup_x" in row["metrics"]
+
+
 # --- obs_report --ledger ----------------------------------------------------
 
 def test_obs_report_renders_ledger_section(tmp_path):

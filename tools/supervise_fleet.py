@@ -52,6 +52,16 @@ With ``--http`` each rank gets an OBS_HTTP_PORT export and serves
 monitor pass then scrapes /health over HTTP and falls back to the
 per-rank file (the journal's ``health_scrape`` events name the
 transport used).
+
+Interrupted-AGREEMENT drill (PR 12, the fault library's supervisor-side
+scenario): the agreement pass journals its ``resume_agreement`` record
+WRITE-AHEAD, so a supervisor that dies mid-``discard_newer`` (drill it
+with ``FLEET_DRILL_DIE_IN_DISCARD=<k>`` — raises after the k-th rank's
+store is swept) leaves an intent a restarted invocation replays before
+its first launch: the remaining ranks' divergent snapshots are
+discarded (idempotently) and FLEET_RESUME_STEP pins the first gang to
+the already-agreed step.  A ``resume_discard_done`` record marks
+completion; only an unmatched intent replays.
 """
 
 from __future__ import annotations
