@@ -1,0 +1,142 @@
+# graftlint: stdlib-only
+"""graftlint — two-front static analysis for repo invariants (PR 13).
+
+The repo's load-bearing conventions were, until this package, enforced
+by runtime probes and reviewer memory: obs/ stays importable without
+jax (a subprocess probe), the ZeRO collective schedules are pinned only
+by runtime golden multisets, env knobs and refusal messages and
+keep-in-sync comments are folklore.  This package turns each into a
+machine-checked contract:
+
+* :mod:`.src_lint` — stdlib-only AST rules over the source tree
+  (import-graph stdlib-only proof, env-var registry, named refusals,
+  the obs wall-clock seam, KEEP-IN-SYNC digest markers).
+* :mod:`.hlo_lint` — declarative contracts over compiled-HLO text
+  (AG/RS pairing and ordering, collective op budgets, donation
+  aliasing, dtype ceilings), reusing ``utils/profiling.py``'s
+  ENTRY-walk.  Imported lazily: it pulls jax, this package root must
+  not.
+* :mod:`.env_registry` — the declared env-knob surface the env rule
+  checks reads against (and dead entries out of).
+
+Findings flow through a checked-in waiver file
+(``analysis/waivers.json``, every waiver dated + reasoned) so the gate
+starts green and only ratchets; ``tools/graftlint.py`` is the CLI and
+tier-1 runs it via the ``lint`` marker (tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+#: Hard cap on checked-in waivers — the gate ratchets toward zero, it
+#: does not accumulate exemptions (ISSUE 12 acceptance: <= 5, dated).
+WAIVER_BUDGET = 5
+
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding, from either front.
+
+    ``key`` is the stable waiver-match identity — rule plus a content
+    token (env name, marker id, message digest), never a line number,
+    so waivers survive unrelated edits.  ``fixable`` marks findings
+    ``tools/graftlint.py --fix`` can mend mechanically.
+    """
+
+    rule: str
+    path: str           # repo-relative (or "<hlo:mode>" for contracts)
+    line: int
+    key: str
+    message: str
+    fixable: bool = False
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def waivers_path(repo_root: str,
+                 package: str = "distributedtensorflowexample_tpu") -> str:
+    return os.path.join(repo_root, package, "analysis", "waivers.json")
+
+
+def load_waivers(path: str) -> tuple[list[dict], list[Finding]]:
+    """Read + validate the waiver file.  Malformed waivers are
+    themselves findings (rule ``waiver-invalid``) — a waiver that
+    doesn't say who/when/why is exactly the folklore this gate exists
+    to end.  A missing file is an empty waiver set, never an error
+    (the gate must run on seeded tmp trees)."""
+    findings: list[Finding] = []
+    rel = os.path.basename(path)
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except FileNotFoundError:
+        return [], []
+    except (OSError, json.JSONDecodeError) as e:
+        return [], [Finding("waiver-invalid", rel, 0,
+                            "waiver-invalid:file",
+                            f"waiver file unreadable: {e}")]
+    waivers = payload.get("waivers", [])
+    good: list[dict] = []
+    for i, w in enumerate(waivers):
+        missing = [k for k in ("key", "reason", "date")
+                   if not isinstance(w.get(k), str) or not w.get(k)]
+        if missing:
+            findings.append(Finding(
+                "waiver-invalid", rel, 0, f"waiver-invalid:{i}",
+                f"waiver #{i} missing {'/'.join(missing)} "
+                f"(every waiver is dated + reasoned): {w!r}"))
+            continue
+        if not _DATE_RE.match(w["date"]):
+            findings.append(Finding(
+                "waiver-invalid", rel, 0, f"waiver-invalid:{i}",
+                f"waiver #{i} date {w['date']!r} is not YYYY-MM-DD"))
+            continue
+        good.append(w)
+    if len(good) > WAIVER_BUDGET:
+        findings.append(Finding(
+            "waiver-budget", rel, 0, "waiver-budget",
+            f"{len(good)} waivers exceed the budget of {WAIVER_BUDGET} "
+            f"— fix findings instead of accumulating exemptions"))
+    return good, findings
+
+
+def apply_waivers(findings: list[Finding], waivers: list[dict],
+                  ran_rules: set[str] | None = None,
+                  waiver_file: str = "waivers.json",
+                  ) -> tuple[list[Finding], list[Finding], list[Finding]]:
+    """Split *findings* into (unwaived, waived) and flag stale waivers.
+
+    A waiver matches a finding by exact ``key`` equality.  A waiver
+    whose key matches nothing is STALE (rule ``waiver-stale``, itself
+    unwaivable) — the ratchet: once a finding is fixed its waiver must
+    leave the file.  Staleness is only judged for rules that actually
+    ran (``ran_rules``; None = all), so a src-only run never flags hlo
+    waivers."""
+    by_key = {w["key"]: w for w in waivers}
+    unwaived, waived = [], []
+    used: set[str] = set()
+    for f in findings:
+        if f.key in by_key:
+            waived.append(f)
+            used.add(f.key)
+        else:
+            unwaived.append(f)
+    stale: list[Finding] = []
+    for key, w in by_key.items():
+        if key in used:
+            continue
+        rule = key.split(":", 1)[0]
+        if ran_rules is not None and rule not in ran_rules:
+            continue
+        stale.append(Finding(
+            "waiver-stale", waiver_file, 0, f"waiver-stale:{key}",
+            f"waiver {key!r} ({w['date']}: {w['reason']}) matches no "
+            f"current finding — delete it (the gate ratchets)"))
+    return unwaived, waived, stale

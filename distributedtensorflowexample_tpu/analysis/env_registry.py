@@ -1,0 +1,98 @@
+# graftlint: stdlib-only
+"""The declared environment-knob surface of the package.
+
+Every ``os.environ`` read (or write) of a named knob inside
+``distributedtensorflowexample_tpu/`` must have an entry here with a
+one-line doc — ``analysis/src_lint.py``'s ``env-registry`` rule proves
+it, and the reverse rule (``env-dead``) flags entries no code reads any
+more, so this file can neither under- nor over-state the real surface.
+``tools/graftlint.py --fix`` inserts ``TODO: document`` stubs for new
+knobs; replace the stub with a real one-liner before merging.
+
+Operator-facing knobs are additionally documented in README.md;
+supervisor-exported coordination variables (SUPERVISE_*/OBS_RANK/...)
+are documented where they are exported.  Keys sorted alphabetically.
+"""
+
+from __future__ import annotations
+
+ENV_REGISTRY: dict[str, str] = {
+    "BUCKET_GRADS_AUTO_BYTES": (
+        "Overrides --bucket_grads auto's measured-knee bucket size "
+        "(bytes) without a code change after a chip re-fit "
+        "(parallel/bucketing.py)."),
+    "DISTTF_TPU_QUIET_SYNTHETIC": (
+        "1 = suppress the loud synthetic-fallback warning when a real "
+        "dataset is absent (data/synthetic.py; CI noise control)."),
+    "DTFE_NATIVE_CACHE": (
+        "Build/cache directory for the native C++ dataio extension "
+        "(native/loader.py; default: a per-user temp dir)."),
+    "FLEET_DRILL_DIE_IN_DISCARD": (
+        "Drill seam: rank to SIGKILL mid-discard so the interrupted-"
+        "agreement replay path stays tested (resilience/fleet.py)."),
+    "OBS_ANOMALY_SKIP": (
+        "Steps ignored at window start before the anomaly baseline "
+        "arms (obs/anomaly.py; default 1 — the compile step)."),
+    "OBS_ANOMALY_WARMUP": (
+        "Steps used to pin the anomaly detector's step-time baseline "
+        "(obs/anomaly.py; default 16)."),
+    "OBS_ANOMALY_Z": (
+        "EWMA z-score threshold before a step time is flagged anomalous "
+        "(obs/anomaly.py; default 8.0)."),
+    "OBS_COLLECTIVES": (
+        "1 = pay one extra AOT compile to record the collective "
+        "inventory of the live step (trainers/common.py)."),
+    "OBS_DIR": (
+        "Directory flight-recorder postmortems land in "
+        "(obs/recorder.py; default: the system temp dir)."),
+    "OBS_FLIGHT": (
+        "1/true = arm the always-on flight recorder: span ring + "
+        "counters + loss tail dumped on exit/signal (obs/recorder.py)."),
+    "OBS_HEALTH": (
+        "Path of the health heartbeat file the serve thread falls back "
+        "to when HTTP is down (obs/serve.py; exported per rank by "
+        "supervise_fleet)."),
+    "OBS_HTTP_PORT": (
+        "Port for the in-process /metrics + /health + /ledger scrape "
+        "endpoint; unset/empty = no server (obs/serve.py)."),
+    "OBS_LEDGER": (
+        "Path of the append-only cross-run RUNS.jsonl ledger; "
+        "unset/empty = no ledger (obs/ledger.py)."),
+    "OBS_LEDGER_MAX_BYTES": (
+        "Ledger size-rotation threshold in bytes (obs/ledger.py; "
+        "default 8 MiB)."),
+    "OBS_LEDGER_SAMPLE_S": (
+        "Minimum seconds between sampled ledger metric rows "
+        "(obs/ledger.py; default 30)."),
+    "OBS_PHASE": (
+        "Capture-phase label stamped on obs events/rows (exported by "
+        "the supervisor's capture queue; obs/trace.py, obs/ledger.py)."),
+    "OBS_PROM_DIR": (
+        "Directory for node-exporter textfile-collector .prom dumps "
+        "refreshed per completed supervised task "
+        "(resilience/supervisor.py)."),
+    "OBS_RANK": (
+        "Process rank label for multi-process telemetry files/rows "
+        "(exported by fleet/multi-host init; obs/*, trainers/common.py)."),
+    "OBS_TRACE_FILE": (
+        "Path to append per-process span events (JSONL) for the "
+        "cross-rank timeline merge; unset = no trace (obs/trace.py)."),
+    "SUPERVISE_ATTEMPT": (
+        "Attempt number of the supervised child, exported by the "
+        "supervisor so obs rows carry retry provenance (obs/*)."),
+    "SUPERVISE_HEARTBEAT": (
+        "Heartbeat file path the supervised child touches per step; "
+        "the watchdog kills on staleness (trainers/common.py, "
+        "resilience/faults.py, obs/recorder.py)."),
+    "SUPERVISE_HEARTBEAT_TIMEOUT_S": (
+        "The watchdog's staleness edge in seconds, exported to "
+        "children so the heartbeat_flap drill can aim at it "
+        "(resilience/faults.py)."),
+    "TF_CONFIG": (
+        "Reference-compatible cluster topology JSON; parsed for "
+        "process count/index compatibility, topology itself is "
+        "jax.distributed's job (cluster.py)."),
+    "XLA_FLAGS": (
+        "XLA backend flags; compat.py appends version-gated CPU "
+        "collective rendezvous flags in-process (read + write)."),
+}

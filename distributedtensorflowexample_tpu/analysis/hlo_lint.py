@@ -1,0 +1,427 @@
+"""Compiled-HLO contract linter (the second front of graftlint).
+
+The collective schedules the ZeRO knobs promise — zero3's every-bucket
+all-gather textually BEFORE its reduce-scatter with no step-closing AG,
+zero1's RS+AG pair, the bucketed modes' op-count budgets — were pinned
+only by runtime golden multisets in tests (arXiv:2004.13336's schedule
+as folklore).  This module makes each a declarative CONTRACT checked
+against compiled-HLO text: the modules that build the schedules declare
+what their compiled form must look like (``HLO_CONTRACT`` next to the
+code in ``parallel/{sync,bucketing,zero3}.py``), and
+:func:`check_contract` proves it on any program text — a freshly
+compiled step, a checked-in artifact, or the synthetic violations
+tests/test_analysis.py plants.
+
+Reuses ``utils/profiling.py``'s ENTRY-walk (:func:`~...profiling.
+entry_walk`) and :func:`~...profiling.collective_inventory` so the
+contract checks and the measurement instruments share ONE parser — no
+second opinion about what a module contains.  Within
+:func:`check_contract` a single ``entry_walk`` serves the schedule,
+donation, and dtype checks; the budget check calls
+``collective_inventory`` (one more pass of the same parser) because
+its trip-count-weighted multiset is the exact number the runtime
+goldens pin.
+
+Contract keys (all optional; a missing key = not checked):
+
+* ``ag_rs_paired`` — k-th all-gather textually precedes the k-th
+  reduce-scatter (the zero3 forward-prefetch shape).
+* ``no_trailing_all_gather`` — no AG after the last RS (zero3: the
+  updated 1/D row writes straight back; a step-closing AG is ZeRO-1
+  leaking in).
+* ``rs_ag_paired`` — k-th RS textually precedes the k-th AG (zero1:
+  the update-closing gather follows its reduce-scatter).
+* ``collective_budget`` — {opcode: count}; int values are upper
+  bounds, symbol expressions (``"B"``/``"B+2"``/``"P+2"`` with B =
+  buckets, P = param leaves, resolved via the ``symbols`` argument)
+  are EXACT — the schedule promises that many, and a count shrunk to
+  zero is as much a regression as growth.  Collectives absent from
+  the budget are findings: a schedule may not grow a new collective
+  silently.
+* ``require_alias`` — the module header must carry a non-empty
+  ``input_output_alias`` (donation actually aliased something).
+* ``no_donated_copy`` — no ENTRY ``copy`` of a donated-and-aliased
+  parameter (a copy-before-write defeats the in-place update donation
+  paid for).
+* ``dtype_ceiling`` — no ``convert`` to a FLOAT dtype wider than the
+  ceiling anywhere in the executed program (quantized paths must not
+  upcast past their declared precision).
+
+This module imports jax transitively (via utils/profiling) — keep it
+out of analysis/__init__ imports; tools/graftlint.py loads it lazily.
+"""
+
+from __future__ import annotations
+
+import re
+
+from distributedtensorflowexample_tpu.analysis import Finding
+from distributedtensorflowexample_tpu.utils.profiling import (
+    _DTYPE_BYTES, _INSTR_RE, _SHAPE_RE, collective_inventory, entry_walk)
+
+HLO_RULES = ("hlo-ag-before-rs", "hlo-trailing-ag", "hlo-rs-ag-pair",
+             "hlo-budget", "hlo-donation", "hlo-dtype-ceiling",
+             "hlo-contract")
+
+_COLLECTIVES = frozenset({"all-reduce", "all-gather", "reduce-scatter",
+                          "all-to-all", "collective-permute"})
+_FLOAT_DTYPES = frozenset({"f16", "bf16", "f32", "f64"})
+_SYM_RE = re.compile(r"^([A-Z])(?:\+(\d+))?$")
+_PARAM_NUM_RE = re.compile(r"parameter\((\d+)\)")
+
+
+def collective_schedule(hlo_text: str,
+                        walk: tuple | None = None) -> list[tuple[str, int]]:
+    """Ordered ``(opcode, position)`` of collective instructions in
+    EXECUTED computations (ENTRY-walk weights > 0), in textual order —
+    which for an ``is_scheduled`` module is issue order within each
+    computation.  Async ``-start`` halves normalize to the base op,
+    ``-done`` halves are skipped (one transfer, not two).  ``walk`` is
+    an optional precomputed ``entry_walk`` result so one parse serves
+    every check (``check_contract`` threads it through)."""
+    comps, entry, weights = walk if walk is not None \
+        else entry_walk(hlo_text)
+    if entry is None:
+        return []
+    live = {name for name, w in weights.items() if w > 0}
+    seq: list[tuple[str, int]] = []
+    pos = 0
+    cur = None
+    for line in hlo_text.splitlines():
+        pos += 1
+        stripped = line.strip()
+        if stripped.endswith("{"):
+            m = re.match(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(", stripped)
+            if m:
+                cur = m.group(1)
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        if cur not in live:
+            continue
+        mi = _INSTR_RE.match(line)
+        if not mi:
+            continue
+        opcode = mi.group(3)
+        base = opcode[:-6] if opcode.endswith("-start") else opcode
+        if base in _COLLECTIVES and not opcode.endswith("-done"):
+            seq.append((base, pos))
+    return seq
+
+
+def _resolve_budget(value, symbols: dict[str, int]) -> int | None:
+    if isinstance(value, int):
+        return value
+    m = _SYM_RE.match(str(value))
+    if not m or m.group(1) not in symbols:
+        return None
+    return symbols[m.group(1)] + int(m.group(2) or 0)
+
+
+def _alias_param_ids(hlo_text: str) -> list[int] | None:
+    """Donated-parameter numbers from the module header's
+    ``input_output_alias={...}`` (balanced-brace scan: entries nest
+    ``{output_index}: (param, {param_index}, kind)``).  None = the
+    header carries no alias map at all."""
+    at = hlo_text.find("input_output_alias=")
+    if at < 0:
+        return None
+    start = hlo_text.find("{", at)
+    if start < 0:
+        return None
+    depth = 0
+    for i in range(start, min(len(hlo_text), start + 100_000)):
+        if hlo_text[i] == "{":
+            depth += 1
+        elif hlo_text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                body = hlo_text[start + 1:i]
+                return sorted({int(m.group(1)) for m in
+                               re.finditer(r"\(\s*(\d+)\s*,", body)})
+    return None
+
+
+def check_contract(hlo_text: str, contract: dict, *,
+                   unroll: int = 1,
+                   symbols: dict[str, int] | None = None) -> list[Finding]:
+    """Check one compiled module against one contract; returns findings
+    (empty = the program honors the contract)."""
+    mode = contract.get("mode", "?")
+    path = f"<hlo:{mode}>"
+    symbols = symbols or {}
+    findings: list[Finding] = []
+    # ONE entry_walk serves the schedule, donation, and dtype checks;
+    # the budget check goes through collective_inventory, the shared
+    # measurement instrument (its weighted multiset is the same number
+    # the runtime goldens pin — deliberately not reimplemented here).
+    walk = entry_walk(hlo_text)
+    comps, entry, weights = walk
+    seq = collective_schedule(hlo_text, walk=walk)
+    ags = [p for op, p in seq if op == "all-gather"]
+    rss = [p for op, p in seq if op == "reduce-scatter"]
+
+    # The paired rules are EXACT when the bucket count is known: B
+    # buckets promise exactly B pairs, so an empty schedule (zero
+    # collectives — e.g. a layout regression that compiles the gathers
+    # away) is a violation, never a vacuous pass.
+    expected_b = symbols.get("B")
+    if contract.get("ag_rs_paired") or contract.get("rs_ag_paired"):
+        if expected_b is not None and (len(ags) != expected_b
+                                       or len(rss) != expected_b):
+            rule = ("hlo-ag-before-rs" if contract.get("ag_rs_paired")
+                    else "hlo-rs-ag-pair")
+            findings.append(Finding(
+                rule, path, 0, f"{rule}:{mode}:buckets",
+                f"{mode}: expected exactly {expected_b} AG/RS pair(s) "
+                f"(one per bucket), found {len(ags)} all-gather(s) / "
+                f"{len(rss)} reduce-scatter(s)"))
+
+    if contract.get("ag_rs_paired"):
+        if len(ags) != len(rss):
+            findings.append(Finding(
+                "hlo-ag-before-rs", path, 0,
+                f"hlo-ag-before-rs:{mode}:count",
+                f"{mode}: {len(ags)} all-gathers vs {len(rss)} "
+                f"reduce-scatters — the per-bucket AG/RS pairing is "
+                f"broken"))
+        else:
+            for k, (a, r) in enumerate(zip(ags, rss)):
+                if a >= r:
+                    findings.append(Finding(
+                        "hlo-ag-before-rs", path, a,
+                        f"hlo-ag-before-rs:{mode}:{k}",
+                        f"{mode}: bucket {k}'s all-gather (line {a}) "
+                        f"does not textually precede its reduce-scatter "
+                        f"(line {r}) — the forward prefetch schedule is "
+                        f"not what compiled"))
+
+    if contract.get("no_trailing_all_gather") and rss:
+        trailing = [a for a in ags if a > max(rss)]
+        if trailing:
+            findings.append(Finding(
+                "hlo-trailing-ag", path, trailing[0],
+                f"hlo-trailing-ag:{mode}",
+                f"{mode}: {len(trailing)} all-gather(s) after the last "
+                f"reduce-scatter — a step-closing AG (the ZeRO-1 "
+                f"update-closing gather) leaked into a schedule that "
+                f"promises none"))
+
+    if contract.get("rs_ag_paired"):
+        if not rss or not ags or len(ags) != len(rss):
+            findings.append(Finding(
+                "hlo-rs-ag-pair", path, 0,
+                f"hlo-rs-ag-pair:{mode}:count",
+                f"{mode}: expected matched RS+AG pairs, got "
+                f"{len(rss)} reduce-scatter(s) / {len(ags)} "
+                f"all-gather(s)"))
+        else:
+            for k, (r, a) in enumerate(zip(rss, ags)):
+                if r >= a:
+                    findings.append(Finding(
+                        "hlo-rs-ag-pair", path, r,
+                        f"hlo-rs-ag-pair:{mode}:{k}",
+                        f"{mode}: bucket {k}'s update-closing all-gather "
+                        f"(line {a}) does not follow its reduce-scatter "
+                        f"(line {r})"))
+
+    budget = contract.get("collective_budget")
+    if budget:
+        inv = collective_inventory(hlo_text, unroll=unroll)
+        multiset = inv["multiset"]
+        for op, count in sorted(multiset.items()):
+            if op not in budget:
+                findings.append(Finding(
+                    "hlo-budget", path, 0, f"hlo-budget:{mode}:{op}",
+                    f"{mode}: collective {op!r} (x{count}) is not in "
+                    f"the mode's declared budget {sorted(budget)} — a "
+                    f"new collective appeared silently"))
+        # Symbol-valued entries ("B"/"B+2"/"P+2") are EXACT — the
+        # schedule promises that many, and a shrunken count (down to
+        # zero, where the op never enters the multiset) is as much a
+        # regression as growth.  Plain ints stay upper bounds.
+        for op, decl in sorted(budget.items()):
+            count = multiset.get(op, 0)
+            cap = _resolve_budget(decl, symbols)
+            if cap is None:
+                findings.append(Finding(
+                    "hlo-budget", path, 0, f"hlo-budget:{mode}:{op}",
+                    f"{mode}: budget {decl!r} for {op} names a "
+                    f"symbol missing from {sorted(symbols)}"))
+            elif isinstance(decl, str) and count != cap:
+                findings.append(Finding(
+                    "hlo-budget", path, 0, f"hlo-budget:{mode}:{op}",
+                    f"{mode}: {count} {op} ops != the exact budget "
+                    f"{decl!r}={cap} — the schedule changed"))
+            elif count > cap:
+                findings.append(Finding(
+                    "hlo-budget", path, 0, f"hlo-budget:{mode}:{op}",
+                    f"{mode}: {count} {op} ops exceed the budget "
+                    f"{decl!r}={cap} — the schedule grew"))
+
+    alias_ids = _alias_param_ids(hlo_text)
+    if contract.get("require_alias") and not alias_ids:
+        findings.append(Finding(
+            "hlo-donation", path, 0, f"hlo-donation:{mode}:alias",
+            f"{mode}: module header carries no input_output_alias — "
+            f"donation aliased nothing (the donated state is being "
+            f"copied, not updated in place)"))
+
+    if contract.get("no_donated_copy") and alias_ids:
+        pname_by_id: dict[str, int] = {}
+        for name, _out, opcode, line, _at in comps.get(entry, ()):
+            if opcode == "parameter":
+                m = _PARAM_NUM_RE.search(line)
+                if m:
+                    pname_by_id[name] = int(m.group(1))
+        donated_names = {n for n, i in pname_by_id.items()
+                         if i in alias_ids}
+        for name, _out, opcode, line, _at in comps.get(entry, ()):
+            if opcode != "copy":
+                continue
+            for dn in donated_names:
+                # The name must end where it ends: HLO names carry
+                # dotted suffixes (%p0 vs %p0.1 are DIFFERENT
+                # instructions), so \b alone would prefix-match.
+                if re.search(rf"%{re.escape(dn)}(?![\w.\-])", line):
+                    findings.append(Finding(
+                        "hlo-donation", path, 0,
+                        f"hlo-donation:{mode}:copy:{dn}",
+                        f"{mode}: donated parameter {dn} (arg "
+                        f"{pname_by_id[dn]}) is copied in ENTRY — the "
+                        f"donation did not alias; the in-place update "
+                        f"is paying for a full copy"))
+
+    ceiling = contract.get("dtype_ceiling")
+    if ceiling:
+        cap_bytes = _DTYPE_BYTES.get(ceiling)
+        if cap_bytes is None:
+            # A misspelled ceiling ("float32"/"fp32") must not
+            # silently disable the check — same stance as an
+            # unresolvable budget symbol.
+            findings.append(Finding(
+                "hlo-dtype-ceiling", path, 0,
+                f"hlo-dtype-ceiling:{mode}:config",
+                f"{mode}: dtype_ceiling {ceiling!r} is not an HLO "
+                f"dtype (expected e.g. 'f32'/'bf16') — the upcast "
+                f"check cannot run"))
+        elif entry is not None:
+            flagged: set[str] = set()
+            for comp, w in weights.items():
+                if w <= 0:
+                    continue
+                for name, out_tok, opcode, _line, _at in comps.get(
+                        comp, ()):
+                    if opcode != "convert":
+                        continue
+                    m = _SHAPE_RE.search(out_tok)
+                    if not m:
+                        continue
+                    dt = m.group(1)
+                    if dt in _FLOAT_DTYPES and dt not in flagged \
+                            and _DTYPE_BYTES.get(dt, 0) > cap_bytes:
+                        flagged.add(dt)
+                        findings.append(Finding(
+                            "hlo-dtype-ceiling", path, 0,
+                            f"hlo-dtype-ceiling:{mode}:{dt}",
+                            f"{mode}: convert to {dt} ({name}) exceeds "
+                            f"the declared dtype ceiling {ceiling} — "
+                            f"a quantized path is silently upcasting"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# The repo's mode suite: compile the per-mode flagship-shaped programs
+# (softmax — the bitwise-pinnable workload every schedule test uses)
+# and check each against the contract declared NEXT TO its step
+# builder.  Needs a live multi-device jax backend; tools/graftlint.py
+# pins CPU devices first when none are configured.
+
+def mode_suite(bucket_bytes: int = 16 << 10) -> list[dict]:
+    """Build + compile the four mode programs and return
+    ``[{mode, hlo, contract, symbols, unroll}]``.  ``bucket_bytes``
+    defaults small enough that softmax splits into TWO buckets, so the
+    per-bucket pairing rules check a real ladder, not the B=1
+    degenerate case."""
+    import jax
+    import optax
+
+    from distributedtensorflowexample_tpu.data import DeviceDataset
+    from distributedtensorflowexample_tpu.data.synthetic import (
+        make_synthetic)
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel import bucketing, sync
+    from distributedtensorflowexample_tpu.parallel import zero3 as z3mod
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        init_bucketed_opt_state, plan_buckets)
+    from distributedtensorflowexample_tpu.parallel.sync import (
+        make_indexed_train_step)
+    from distributedtensorflowexample_tpu.parallel.zero3 import Zero3Layout
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    mesh = make_mesh()
+    x, y = make_synthetic(512, (28, 28, 1), 10, seed=0)
+    mk_tx = lambda: optax.sgd(0.1, momentum=0.9)   # noqa: E731
+
+    def state():
+        return TrainState.create_sharded(build_model("softmax"), mk_tx(),
+                                         (64, 28, 28, 1), 0,
+                                         replicated_sharding(mesh))
+
+    def compiled_text(step, st, ds):
+        with mesh:
+            return step.lower(st, ds.peek()).compile().as_text()
+
+    s0 = state()
+    leaves = jax.tree.leaves(s0.params)
+    symbols = {"P": len(leaves),
+               "B": len(plan_buckets(leaves, bucket_bytes))}
+    ds = DeviceDataset(x, y, 64, mesh=mesh, seed=4)
+    mk = dict(mesh=mesh, num_slots=ds.num_slots)
+    out = []
+
+    plain = make_indexed_train_step(64, ds.steps_per_epoch, **mk)
+    out.append({"mode": "sync_dp", "hlo": compiled_text(plain, s0, ds),
+                "contract": sync.HLO_CONTRACT, "symbols": symbols})
+
+    bkt = make_indexed_train_step(64, ds.steps_per_epoch,
+                                  bucket_bytes=bucket_bytes, **mk)
+    out.append({"mode": "bucketed_allreduce",
+                "hlo": compiled_text(bkt, state(), ds),
+                "contract": bucketing.BUCKETED_HLO_CONTRACT,
+                "symbols": symbols})
+
+    z1 = make_indexed_train_step(64, ds.steps_per_epoch,
+                                 bucket_bytes=bucket_bytes,
+                                 bucket_shard_update=True, **mk)
+    s_z1 = state()
+    s_z1 = s_z1.replace(opt_state=init_bucketed_opt_state(
+        mk_tx(), s_z1.params, bucket_bytes, mesh))
+    out.append({"mode": "zero1", "hlo": compiled_text(z1, s_z1, ds),
+                "contract": bucketing.ZERO1_HLO_CONTRACT,
+                "symbols": symbols})
+
+    s_z3 = state()
+    layout = Zero3Layout(s_z3.params, bucket_bytes, mesh)
+    z3 = make_indexed_train_step(64, ds.steps_per_epoch,
+                                 zero3_layout=layout, **mk)
+    s_z3 = s_z3.replace(opt_state=init_bucketed_opt_state(
+        mk_tx(), s_z3.params, bucket_bytes, mesh))
+    s_z3 = s_z3.replace(params=layout.init_rows(s_z3.params))
+    out.append({"mode": "zero3", "hlo": compiled_text(z3, s_z3, ds),
+                "contract": z3mod.HLO_CONTRACT,
+                "symbols": dict(symbols, B=layout.num_buckets)})
+    return out
+
+
+def run_hlo_lint(bucket_bytes: int = 16 << 10) -> list[Finding]:
+    """Compile the mode suite and check every program against its
+    declared contract — the graftlint HLO front."""
+    findings: list[Finding] = []
+    for prog in mode_suite(bucket_bytes=bucket_bytes):
+        findings += check_contract(prog["hlo"], prog["contract"],
+                                   symbols=prog["symbols"])
+    return findings

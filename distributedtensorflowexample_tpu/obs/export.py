@@ -15,7 +15,6 @@ between two states — not between two dict orderings.
 from __future__ import annotations
 
 import json
-import time
 
 from distributedtensorflowexample_tpu.obs import metrics as _metrics
 from distributedtensorflowexample_tpu.obs import recorder as _recorder
@@ -94,7 +93,10 @@ class JsonlExporter:
                registry: _metrics.MetricsRegistry | None = None) -> dict:
         reg = registry or _metrics.registry()
         snap = reg.snapshot()
-        rec = {"unix_ts": round(time.time(), 3),
+        # Through the _wall seam (not time.time directly): the PR-13
+        # clock-seam rule — a test that pins the seam must pin THIS
+        # stamp too, or JSONL exports are not bitwise-reproducible.
+        rec = {"unix_ts": round(_metrics._wall(), 3),
                "snapshot": snap,
                "delta": (_metrics.MetricsRegistry.delta(self._prev, snap)
                          if self._prev is not None else None)}

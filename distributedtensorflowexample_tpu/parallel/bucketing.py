@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import optax
 
 from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
 
 # --bucket_grads auto: sized from the measured CPU-mesh all-reduce knee
 # (bench_collectives.py: 8-device psum knee 244 KB at r2=0.99,
@@ -79,6 +80,32 @@ from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
 # --real phase re-fits the knee, and BUCKET_GRADS_AUTO_BYTES overrides
 # without a code change.
 DEFAULT_BUCKET_BYTES = 1 << 20
+
+# Compiled-schedule contracts, checked by analysis/hlo_lint.py against
+# the lowered module text (PR 13) — the static twin of the runtime
+# golden multisets in tests/test_collectives.py.  Symbols resolve at
+# check time: B = buckets in the plan.
+#
+# Bucketed all-reduce: N_params gradient ARs collapse to one AR per
+# bucket + the fused metrics pair; nothing else may appear on the wire.
+BUCKETED_HLO_CONTRACT = {
+    "mode": "bucketed_allreduce",
+    "collective_budget": {"all-reduce": "B+2"},
+    "require_alias": True,
+    "dtype_ceiling": "f32",
+}
+# ZeRO-1 (arXiv:2004.13336): per bucket one reduce-scatter then its
+# UPDATE-CLOSING all-gather (rs_ag_paired — the AG textually follows
+# its RS: gather the updated row, not the gradient), plus the metrics
+# pair.  Contrast zero3.HLO_CONTRACT, where the pairing flips.
+ZERO1_HLO_CONTRACT = {
+    "mode": "zero1",
+    "rs_ag_paired": True,
+    "collective_budget": {"reduce-scatter": "B", "all-gather": "B",
+                          "all-reduce": 2},
+    "require_alias": True,
+    "dtype_ceiling": "f32",
+}
 
 
 def resolve_bucket_bytes(flag: str) -> int | None:
@@ -100,11 +127,14 @@ def resolve_bucket_bytes(flag: str) -> int | None:
     try:
         nbytes = int(flag)
     except ValueError:
-        raise ValueError(f"{source} must be 'auto' or a byte count, "
-                         f"got {flag!r}") from None
+        # ModeRefusal even though the flag name rides in `source` (the
+        # named-refusal lint can only see literal --tokens): these ARE
+        # mode-legality refusals and must stay on the one grep.
+        raise ModeRefusal(f"{source} must be 'auto' or a byte count, "
+                          f"got {flag!r}") from None
     if nbytes <= 0:
-        raise ValueError(f"{source} byte count must be positive, "
-                         f"got {nbytes}")
+        raise ModeRefusal(f"{source} byte count must be positive, "
+                          f"got {nbytes}")
     return nbytes
 
 
@@ -228,7 +258,7 @@ def build_bucketed_step_fn(label_smoothing: float, ce_impl: str, mesh,
 
     def step(state, batch):
         if state.batch_stats:
-            raise ValueError(
+            raise ModeRefusal(
                 "--bucket_grads cannot run a BatchNorm model: the default "
                 "GSPMD step computes global-batch statistics and the "
                 "bucketed per-shard region would silently turn them into "

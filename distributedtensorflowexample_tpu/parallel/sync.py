@@ -26,6 +26,20 @@ from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
 from distributedtensorflowexample_tpu.ops.losses import accuracy
 from distributedtensorflowexample_tpu.training.state import TrainState
 
+# What the compiled default sync step must look like, checked by
+# analysis/hlo_lint.py against the lowered module text (PR 13): one
+# gradient all-reduce per param leaf plus the two scalar metric
+# all-reduces and nothing else on the wire, state donation actually
+# aliased (in-place HBM update — the claim in this module's docstring),
+# and no float upcast past f32 (the quantized input paths dequantize to
+# f32, never f64).  Symbols resolve at check time: P = param leaves.
+HLO_CONTRACT = {
+    "mode": "sync_dp",
+    "collective_budget": {"all-reduce": "P+2"},
+    "require_alias": True,
+    "dtype_ceiling": "f32",
+}
+
 
 def _per_example_rows(impl: Callable) -> Callable:
     """Adapt a [rows, C] loss kernel to ALSO accept sequence logits

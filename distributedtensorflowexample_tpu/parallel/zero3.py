@@ -88,6 +88,28 @@ import optax
 from distributedtensorflowexample_tpu.parallel.bucketing import (
     _bucket_flat2d, _unbucket_rows, bucket_padding_bytes, plan_buckets)
 from distributedtensorflowexample_tpu.parallel.mesh import DATA_AXIS
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+
+# The ZeRO-3 schedule as a compiled-HLO contract (analysis/hlo_lint.py,
+# PR 13) — the static form of the claims in the module docstring, each
+# previously pinned only by runtime golden multisets: every bucket's
+# forward-prefetch all-gather textually PRECEDES its reduce-scatter
+# (ag_rs_paired — autodiff's all_gather transpose placed the RS in the
+# backward), NO all-gather after the last RS (the updated 1/D row
+# writes straight back; a trailing AG would be ZeRO-1's update-closing
+# gather leaking into a schedule that promises none), exactly one
+# AG + one RS per bucket + the fused metrics pair on the wire, donation
+# aliased (the row buffers update in place), no float upcast past f32.
+# Symbols resolve at check time: B = buckets in the layout's plan.
+HLO_CONTRACT = {
+    "mode": "zero3",
+    "ag_rs_paired": True,
+    "no_trailing_all_gather": True,
+    "collective_budget": {"all-gather": "B", "reduce-scatter": "B",
+                          "all-reduce": 2},
+    "require_alias": True,
+    "dtype_ceiling": "f32",
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,7 +260,7 @@ def build_zero3_step_fn(label_smoothing: float, ce_impl: str, mesh,
 
     def step(state, batch):
         if state.batch_stats:
-            raise ValueError(
+            raise ModeRefusal(
                 "--shard_params cannot run a BatchNorm model: the default "
                 "GSPMD step computes global-batch statistics and the "
                 "sharded per-device region would silently turn them into "

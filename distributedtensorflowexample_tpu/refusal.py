@@ -1,0 +1,31 @@
+# graftlint: stdlib-only
+"""The one shape every mode-legality refusal goes through.
+
+The repo's correctness story leans on *refusal by name*: an illegal knob
+combination (``--shard_params`` under async, ``--bucket_grads`` on a
+BatchNorm model, a cross-layout resume) fails at flag-validation time
+with a message that names the flag and says why the combination is a
+different model or a different program — never a silent fallback.  Until
+PR 13 that convention lived in reviewer memory: refusals were bare
+``ValueError``\\ s, greppable only by knowing each message.
+
+:class:`ModeRefusal` is the machine-checked form.  It subclasses
+``ValueError`` so every existing ``except ValueError`` /
+``pytest.raises(ValueError)`` site keeps working, and it is the ONE
+class ``grep -rn ModeRefusal`` needs to enumerate the repo's whole
+mode-legality surface.  The contract is enforced statically:
+``analysis/src_lint.py``'s ``named-refusal`` rule flags any package
+``raise ValueError`` whose message names a CLI flag (a ``--token``) —
+that message is a mode-legality refusal and must be a ModeRefusal.
+"""
+
+from __future__ import annotations
+
+
+class ModeRefusal(ValueError):
+    """A named refusal of an illegal mode/knob combination.
+
+    Raise with a message that (a) names the flag(s) by their CLI
+    spelling and (b) says why the combination is refused rather than
+    degraded — the existing refusal messages are the style guide.
+    """

@@ -97,6 +97,7 @@ import numpy as np
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
 from distributedtensorflowexample_tpu.obs import trace as obs_trace
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
 from distributedtensorflowexample_tpu.training.hooks import (
     Hook, _EveryN, touch_heartbeat)
 
@@ -334,7 +335,7 @@ class FaultInjectionHook(Hook):
                 if not hb:
                     # Same discipline: without a beat file the "flap"
                     # would stall the boundary and beat into nothing.
-                    raise ValueError(
+                    raise ModeRefusal(
                         "heartbeat_flap has no heartbeat file to beat "
                         "(SUPERVISE_HEARTBEAT unset) — run under "
                         "supervise.py with --heartbeat/"

@@ -29,6 +29,7 @@ from distributedtensorflowexample_tpu.parallel.async_ps import (
     make_worker_state)
 from distributedtensorflowexample_tpu.parallel.sync import (
     evaluate, make_indexed_train_step, make_resident_eval, make_train_step)
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
 from distributedtensorflowexample_tpu.training.checkpoint import CheckpointManager
 from distributedtensorflowexample_tpu.training.hooks import (
     CheckpointHook, EvalHook)
@@ -86,7 +87,7 @@ def _load_dataset(cfg: RunConfig, name: str, split: str):
     the deterministic synthetic split (VERDICT r4 #5: no silent
     substitution on the trainer surface)."""
     if cfg.dataset not in (name, "synthetic"):
-        raise ValueError(
+        raise ModeRefusal(
             f"--dataset {cfg.dataset!r} does not match this trainer's "
             f"dataset {name!r}; pass --dataset {name} (real bytes in "
             f"--data_dir) or --dataset synthetic")
@@ -114,7 +115,7 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
     if not saved:
         return
     if saved.get("sync_mode", current["sync_mode"]) != current["sync_mode"]:
-        raise ValueError(
+        raise ModeRefusal(
             f"checkpoint in {log_dir}/checkpoints was written by a "
             f"sync_mode={saved['sync_mode']!r} run; restoring it into "
             f"sync_mode={current['sync_mode']!r} would mismatch the state "
@@ -126,7 +127,7 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
     # into a bucket_rows run and die on an unnamed Orbax mismatch).
     saved_layout = saved.get("update_layout", "tree")
     if saved_layout != current.get("update_layout"):
-        raise ValueError(
+        raise ModeRefusal(
             f"checkpoint in {log_dir}/checkpoints holds "
             f"{saved_layout!r} optimizer state; this run uses "
             f"{current['update_layout']!r} (--bucket_grads with "
@@ -142,7 +143,7 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
         # shape error and at worst — when the padded totals happen to
         # match — a silently PERMUTED momentum (or, for zero3_rows,
         # PARAM) restore.
-        raise ValueError(
+        raise ModeRefusal(
             f"checkpoint in {log_dir}/checkpoints holds {saved_layout} "
             f"state laid out for mesh_size="
             f"{saved['mesh_size']}; this run has mesh_size="
@@ -151,7 +152,7 @@ def _refuse_incompatible_restore(saved: dict | None, current: dict,
             f"with a new --log_dir")
     if (saved.get("num_workers") is not None
             and saved["num_workers"] != current["num_workers"]):
-        raise ValueError(
+        raise ModeRefusal(
             f"checkpoint in {log_dir}/checkpoints holds async worker-tiled "
             f"state for num_workers={saved['num_workers']}; this run has "
             f"num_workers={current['num_workers']} (mesh size "
@@ -173,7 +174,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         # pallas_call has no batching rule XLA can partition over the
         # worker-sharded axis. (The Pallas CE head IS supported in async —
         # it runs on the flattened batch outside the vmap.)
-        raise ValueError(
+        raise ModeRefusal(
             "--fused_optimizer is not supported with sync_mode=async")
     info = cluster.resolve(cfg)
     if info.role == "ps":
@@ -219,7 +220,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         digests = multihost_utils.process_allgather(
             np.uint32(zlib.crc32(blob)))
         if len({int(d) for d in digests}) > 1:
-            raise ValueError(
+            raise ModeRefusal(
                 f"run configuration differs across the "
                 f"{jax.process_count()} processes (config digests "
                 f"{sorted({int(d) for d in digests})}). Collective "
@@ -245,7 +246,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     # name instead.
     token_data = dataset_name == "lm"
     if token_data and cfg.device_data == "off":
-        raise ValueError(
+        raise ModeRefusal(
             "the lm dataset is an integer token split and runs on the "
             "device-resident input path only; --device_data off selects "
             "the host float-image Batcher, which would dequantize token "
@@ -255,7 +256,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     if cfg.data_sharding not in ("replicated", "sharded"):
         raise ValueError(f"unknown data_sharding {cfg.data_sharding!r}")
     if cfg.data_sharding == "sharded" and cfg.device_data == "off":
-        raise ValueError("--data_sharding sharded requires the "
+        raise ModeRefusal("--data_sharding sharded requires the "
                          "device-resident input path (device_data)")
     from distributedtensorflowexample_tpu.data.device_dataset import (
         DEQUANT_IMPLS)
@@ -264,11 +265,11 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                          f"(one of {DEQUANT_IMPLS})")
     if cfg.dequant_impl == "pallas" and (cfg.device_data == "off"
                                          or cfg.data_sharding == "sharded"):
-        raise ValueError("--dequant_impl pallas fuses the on-device row "
+        raise ModeRefusal("--dequant_impl pallas fuses the on-device row "
                          "gather with the dequant; it requires the "
                          "replicated device-resident input path")
     if cfg.shard_update and cfg.sync_mode == "async":
-        raise ValueError(
+        raise ModeRefusal(
             "--shard_update shards ONE replicated update across the mesh; "
             "async mode's state is already worker-tiled (each device owns "
             "its workers' whole update) — there is no cross-replica "
@@ -277,18 +278,18 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
         resolve_bucket_bytes)
     bucket_bytes = resolve_bucket_bytes(cfg.bucket_grads)  # fails by name
     if bucket_bytes and cfg.fused_optimizer:
-        raise ValueError(
+        raise ModeRefusal(
             "--bucket_grads restructures the gradient reduction around "
             "the optimizer apply; the Pallas fused apply is a custom "
             "call with its own layout contract — use one or the other")
     if cfg.shard_params and cfg.sync_mode != "sync":
-        raise ValueError(
+        raise ModeRefusal(
             "--shard_params shards the sync data-parallel step's params "
             "across the mesh; async mode's state is worker-tiled (each "
             "device already owns its workers' whole copy) — there is no "
             "cross-replica redundancy to shard away")
     if cfg.shard_params and not bucket_bytes:
-        raise ValueError(
+        raise ModeRefusal(
             "--shard_params lays params out in the knee-sized "
             "dtype-homogeneous bucket rows; pass --bucket_grads (auto, "
             "or a byte cap) to size them")
@@ -337,7 +338,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
     state = TrainState.create_sharded(model, tx, sample_shape, cfg.seed, repl)
     if bucket_bytes and cfg.sync_mode == "sync" and num_replicas > 1 \
             and state.batch_stats:
-        raise ValueError(
+        raise ModeRefusal(
             f"--bucket_grads cannot run {model_name!r}: its BatchNorm "
             f"computes global-batch statistics, which the bucketed "
             f"per-shard gradient region would silently turn into "
@@ -381,7 +382,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
 
     is_async = cfg.sync_mode == "async"
     if is_async and cfg.replicas_to_aggregate:
-        raise ValueError(
+        raise ModeRefusal(
             "--replicas_to_aggregate is a SyncReplicasOptimizer (sync-mode) "
             "concept; async mode has no aggregation barrier to relax")
     if is_async:
@@ -482,7 +483,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                 # The loop advances in steps_per_call strides; a
                 # non-multiple remainder would silently under-run the
                 # target step count.
-                raise ValueError(
+                raise ModeRefusal(
                     f"remaining steps {remaining} (train_steps "
                     f"{cfg.train_steps} - resumed step {int(state.step)}) "
                     f"must be a multiple of --steps_per_loop "
@@ -498,7 +499,7 @@ def run_training(cfg: RunConfig, model_name: str, dataset_name: str,
                            token_data=token_data)
         batches = ds
     elif cfg.steps_per_loop > 1:
-        raise ValueError("--steps_per_loop > 1 requires the "
+        raise ModeRefusal("--steps_per_loop > 1 requires the "
                          "device-resident input path (device_data)")
 
     if is_async and use_device_data:

@@ -18,6 +18,7 @@ import jax
 import optax
 
 from distributedtensorflowexample_tpu.config import RunConfig
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
 
 
 def build_schedule(cfg: RunConfig) -> optax.Schedule:
@@ -134,12 +135,12 @@ def build_optimizer(cfg: RunConfig, mesh=None,
     sched = build_schedule(cfg)
     if cfg.fused_optimizer:
         if cfg.momentum <= 0.0 or cfg.weight_decay > 0.0:
-            raise ValueError(
+            raise ModeRefusal(
                 "--fused_optimizer implements momentum SGD only; it needs "
                 f"momentum > 0 (got {cfg.momentum}) and weight_decay == 0 "
                 f"(got {cfg.weight_decay})")
         if cfg.shard_update:
-            raise ValueError(
+            raise ModeRefusal(
                 "--shard_update shards the update with XLA sharding "
                 "constraints; the Pallas fused apply is a custom call XLA "
                 "cannot re-partition — use one or the other")
@@ -155,7 +156,7 @@ def build_optimizer(cfg: RunConfig, mesh=None,
         tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
     if cfg.shard_update:
         if mesh is None:
-            raise ValueError("--shard_update requires a device mesh")
+            raise ModeRefusal("--shard_update requires a device mesh")
         if wrap_shard_update:
             tx = cross_replica_update_sharding(tx, mesh)
     return tx

@@ -263,6 +263,22 @@ def _computation_weights(comps: dict, entry: str, unroll: int) -> dict:
     return weights
 
 
+def entry_walk(hlo_text: str, unroll: int = 1) -> tuple[dict, str | None,
+                                                        dict]:
+    """The public seam over the ENTRY-walk every per-program instrument
+    shares: ``(computations, entry_name, execution_weights)`` for one
+    optimized-HLO text.  ``computations`` maps name -> instruction
+    tuples ``(name, out_token, opcode, raw_line, operand_start)``;
+    ``entry_name`` is None when the text has no ENTRY (weights then
+    empty).  Callers: the bytes/flops audits and collective inventory
+    below, and ``analysis/hlo_lint.py``'s contract checks — one parse,
+    one opinion about what the module contains."""
+    comps, entry = _split_computations(hlo_text)
+    if entry is None:
+        return comps, None, {}
+    return comps, entry, _computation_weights(comps, entry, unroll)
+
+
 def hlo_bytes_by_op(hlo_text: str, unroll: int = 1) -> list:
     """Per-instruction bytes rows from optimized HLO text.
 
@@ -274,10 +290,9 @@ def hlo_bytes_by_op(hlo_text: str, unroll: int = 1) -> list:
     ``opcode``, ``name``, ``out`` (output shape token) and ``op_name``
     (source metadata — the flax module path for model ops).
     """
-    comps, entry = _split_computations(hlo_text)
+    comps, entry, weights = entry_walk(hlo_text, unroll)
     if entry is None:
         return []
-    weights = _computation_weights(comps, entry, unroll)
 
     rows = []
     for comp, weight in weights.items():
@@ -433,10 +448,9 @@ def hlo_flops_by_op(hlo_text: str, unroll: int = 1) -> list:
     (weighted like :func:`hlo_bytes_by_op`: control flow walked from
     ENTRY, scan bodies by trip count; dots INSIDE a fusion priced from
     the fused computation at the fusion's weight)."""
-    comps, entry = _split_computations(hlo_text)
+    comps, entry, weights = entry_walk(hlo_text, unroll)
     if entry is None:
         return []
-    weights = _computation_weights(comps, entry, unroll)
 
     def fused_rows(target: str, weight: int, via: str):
         out = []
@@ -639,13 +653,12 @@ def collective_inventory(hlo_text: str, unroll: int = 1) -> dict:
     ``conditional`` (e.g. the async worker average, gated on the period)
     are counted at the caller's weight — sustained traffic for
     period-gated ops is count/period, which the caller divides."""
-    comps, entry = _split_computations(hlo_text)
+    comps, entry, weights = entry_walk(hlo_text, unroll)
     empty = {"ops": [], "per_step": {}, "multiset": {},
              "total_count_per_step": 0, "total_out_bytes_per_step": 0,
              "total_accounting_bytes_per_step": 0, "unroll": max(1, unroll)}
     if entry is None:
         return empty
-    weights = _computation_weights(comps, entry, unroll)
 
     rows = []
     for comp, weight in weights.items():

@@ -1,0 +1,481 @@
+"""graftlint self-tests (PR 13): one seeded violation per rule, the
+real-repo zero-findings gate, the --fix round trip, and the compiled
+mode suite honoring its declared HLO contracts.
+
+The seeded trees plant EXACTLY one violation each and assert the exact
+finding key fires — a rule that silently stops matching is itself the
+regression these tests exist to catch.  The repo gate
+(test_repo_src_lint_is_clean...) is the tier-1 wiring: it runs the same
+rules the CLI runs and fails on any unwaived finding, so an invariant
+break fails the suite inline, not in a tool nobody ran.
+
+Marker strings for the keep-in-sync tests are built by concatenation so
+THIS file never contains a literal marker the repo-wide scan would
+pick up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributedtensorflowexample_tpu.analysis import (
+    WAIVER_BUDGET, apply_waivers, load_waivers, src_lint, waivers_path)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "distributedtensorflowexample_tpu"
+GRAFTLINT = os.path.join(REPO, "tools", "graftlint.py")
+
+_MARK = "KEEP-IN-" + "SYNC"     # never a literal marker in this file
+
+
+def _seed(tmp_path, files: dict) -> str:
+    """Materialize a seeded repo tree with package ``seedpkg``."""
+    root = tmp_path / "seedrepo"
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    init = root / "seedpkg" / "__init__.py"
+    if not init.exists():
+        init.parent.mkdir(parents=True, exist_ok=True)
+        init.write_text("")
+    return str(root)
+
+
+def _keys(findings, rule=None):
+    return [f.key for f in findings if rule is None or f.rule == rule]
+
+
+# --- stdlib-only (import graph) --------------------------------------------
+
+def test_stdlib_only_rule_fires_with_import_chain(tmp_path):
+    """obs/ reaching numpy directly AND a tagged module reaching jax
+    through an intermediate package module both fire, with the chain
+    in the message (the part the old subprocess probe could not say)."""
+    root = _seed(tmp_path, {
+        "seedpkg/obs/__init__.py": "",
+        "seedpkg/obs/bad.py": "import numpy\n",
+        "seedpkg/util.py": "import jax\n",
+        "seedpkg/tagged.py": ("# graftlint: stdlib-only\n"
+                              "from seedpkg.util import thing\n"),
+    })
+    fs = src_lint.run_src_lint(root, "seedpkg", rules=("stdlib-only",))
+    keys = _keys(fs)
+    assert "stdlib-only:obs.bad:numpy" in keys
+    assert "stdlib-only:util:jax" in keys
+    chain = [f for f in fs if f.key == "stdlib-only:util:jax"][0]
+    assert "tagged" in chain.message and "util" in chain.message
+    # A clean tree is clean: function-level imports are lazy, not
+    # import-time reachability.
+    clean = _seed(tmp_path / "c", {
+        "seedpkg/obs/__init__.py": "",
+        "seedpkg/obs/ok.py": ("import json\n"
+                              "def lazy():\n    import numpy\n"),
+    })
+    assert src_lint.run_src_lint(clean, "seedpkg",
+                                 rules=("stdlib-only",)) == []
+
+
+# --- env registry -----------------------------------------------------------
+
+_ENV_SEED = {
+    "seedpkg/knobs.py": """\
+        import os
+
+        def _env_float(name, default):
+            try:
+                return float(os.environ.get(name, ""))
+            except ValueError:
+                return default
+
+        READ = os.environ.get("SEED_UNDECLARED")
+        VIA_HELPER = _env_float("SEED_VIA_HELPER", 1.0)
+
+        def orphan_helper(name):
+            return os.environ[name]
+        """,
+    "seedpkg/aliased_knobs.py": """\
+        from os import environ as _e, getenv
+
+        A = _e.get("SEED_FROM_IMPORT")
+        B = getenv("SEED_GETENV")
+        """,
+    "seedpkg/analysis/env_registry.py": """\
+        ENV_REGISTRY: dict[str, str] = {
+            "SEED_DEAD_KNOB": "never read anywhere.",
+        }
+        """,
+}
+
+
+def test_env_registry_rules_fire(tmp_path):
+    root = _seed(tmp_path, _ENV_SEED)
+    fs = src_lint.run_src_lint(
+        root, "seedpkg", rules=("env-registry", "env-dynamic", "env-dead"))
+    keys = _keys(fs)
+    # Named read not in registry; helper call sites resolve to a name
+    # (also unregistered); a helper nothing calls with a constant is a
+    # dynamic finding; the registry's orphan entry is a dead knob.
+    assert "env-registry:SEED_UNDECLARED" in keys
+    assert "env-registry:SEED_VIA_HELPER" in keys
+    # Import aliases don't launder a knob read (from os import environ
+    # as _e / bare getenv — the idioms the registry claim must cover).
+    assert "env-registry:SEED_FROM_IMPORT" in keys
+    assert "env-registry:SEED_GETENV" in keys
+    assert "env-dynamic:seedpkg/knobs.py:orphan_helper" in keys
+    assert "env-dead:SEED_DEAD_KNOB" in keys
+    assert len(keys) == 6
+
+
+def test_fix_inserts_registry_stubs_and_relints_clean(tmp_path):
+    root = _seed(tmp_path, _ENV_SEED)
+    applied = src_lint.apply_fixes(root, "seedpkg")
+    assert any("SEED_UNDECLARED" in a for a in applied)
+    fs = src_lint.run_src_lint(root, "seedpkg",
+                               rules=("env-registry", "env-dynamic"))
+    # The two mechanical findings are gone; the dynamic orphan (not
+    # mechanical) survives --fix, as it should.
+    assert _keys(fs, "env-registry") == []
+    assert _keys(fs, "env-dynamic") == [
+        "env-dynamic:seedpkg/knobs.py:orphan_helper"]
+    text = (tmp_path / "seedrepo/seedpkg/analysis/env_registry.py"
+            ).read_text()
+    assert '"SEED_UNDECLARED"' in text and "TODO" in text
+
+
+def test_fix_handles_one_liner_registry(tmp_path):
+    """A hand-written `ENV_REGISTRY: dict[str, str] = {}` one-liner
+    (no bare closing-brace line) must not crash --fix."""
+    root = _seed(tmp_path, {
+        "seedpkg/m.py": 'import os\nX = os.environ.get("SEED_ONE")\n',
+        "seedpkg/analysis/env_registry.py":
+            "ENV_REGISTRY: dict[str, str] = {}\n",
+    })
+    applied = src_lint.apply_fixes(root, "seedpkg")
+    assert any("SEED_ONE" in a for a in applied)
+    assert src_lint.run_src_lint(root, "seedpkg",
+                                 rules=("env-registry",)) == []
+
+
+# --- named refusal ----------------------------------------------------------
+
+def test_named_refusal_rule_fires_on_flag_bearing_valueerror(tmp_path):
+    root = _seed(tmp_path, {
+        "seedpkg/modes.py": """\
+            class ModeRefusal(ValueError):
+                pass
+
+            def check(flag):
+                if flag == "bad":
+                    raise ValueError(
+                        "--seed_knob cannot run with --other_knob")
+                if flag == "ok":
+                    raise ModeRefusal("--seed_knob refused by name")
+                raise ValueError(f"unknown flag {flag!r}")
+            """,
+    })
+    fs = src_lint.run_src_lint(root, "seedpkg", rules=("named-refusal",))
+    assert len(fs) == 1                       # only the bare ValueError
+    assert fs[0].key.startswith("named-refusal:seedpkg/modes.py:")
+    assert "--seed_knob" in fs[0].message
+
+
+# --- clock seam -------------------------------------------------------------
+
+def test_clock_seam_rule_fires_outside_metrics(tmp_path):
+    root = _seed(tmp_path, {
+        "seedpkg/obs/__init__.py": "",
+        # The seam's home is exempt: it ASSIGNS the clocks, tests
+        # monkeypatch it.
+        "seedpkg/obs/metrics.py": ("import time\n"
+                                   "_now = time.monotonic\n"
+                                   "_wall = time.time\n"
+                                   "def stamp():\n"
+                                   "    return time.time()\n"),
+        "seedpkg/obs/leaky.py": ("import time\n"
+                                 "from datetime import datetime\n"
+                                 "def stamp():\n"
+                                 "    return time.time()\n"
+                                 "def when():\n"
+                                 "    return datetime.now()\n"),
+        # Aliases don't launder the clock; a same-named LOCAL helper
+        # (no time/datetime import behind it) is not a finding.
+        "seedpkg/obs/aliased.py": ("import time as _t\n"
+                                   "from time import time as _wallclock\n"
+                                   "def a():\n"
+                                   "    return _t.monotonic()\n"
+                                   "def b():\n"
+                                   "    return _wallclock()\n"
+                                   "def now():\n"
+                                   "    return 0\n"
+                                   "def c():\n"
+                                   "    return now()\n"),
+    })
+    fs = src_lint.run_src_lint(root, "seedpkg", rules=("clock-seam",))
+    keys = _keys(fs)
+    assert any("leaky.py:time.time" in k for k in keys)
+    assert any("datetime.now" in k for k in keys)
+    assert any("aliased.py:time.monotonic" in k for k in keys)
+    assert any("aliased.py:time:" in k for k in keys)   # _wallclock()
+    assert not any("metrics" in k for k in keys)
+    assert len(keys) == 4
+
+
+# --- keep-in-sync -----------------------------------------------------------
+
+def _sync_pair(tmp_path, body_a="alpha\n", stamp=""):
+    return _seed(tmp_path, {
+        "a.py": (f"# {_MARK}(pairdemo){stamp}\n"
+                 f"# {body_a}"
+                 f"# {_MARK}-END(pairdemo)\n"),
+        "b.sh": (f"# {_MARK}(pairdemo){stamp}\n"
+                 f"# alpha\n"
+                 f"# {_MARK}-END(pairdemo)\n"),
+    })
+
+
+def test_keep_in_sync_digest_lifecycle(tmp_path):
+    root = _sync_pair(tmp_path)
+    fs = src_lint.run_src_lint(root, "seedpkg", rules=("keep-in-sync",))
+    assert sorted(_keys(fs)) == ["keep-in-sync:pairdemo:a.py",
+                                 "keep-in-sync:pairdemo:b.sh"]
+    assert all(f.fixable for f in fs)
+    # --fix stamps both sides with one digest; re-lint is clean.
+    src_lint.apply_fixes(root, "seedpkg")
+    assert src_lint.run_src_lint(root, "seedpkg",
+                                 rules=("keep-in-sync",)) == []
+    # Content drift on ONE side stales BOTH digests (the rule's point:
+    # an edit must acknowledge the partner), and --fix re-converges.
+    a = os.path.join(root, "a.py")
+    with open(a) as f:
+        drifted = f.read().replace("# alpha", "# beta")
+    with open(a, "w") as f:
+        f.write(drifted)
+    fs = src_lint.run_src_lint(root, "seedpkg", rules=("keep-in-sync",))
+    assert sorted(_keys(fs)) == ["keep-in-sync:pairdemo:a.py",
+                                 "keep-in-sync:pairdemo:b.sh"]
+    assert all("drifted" in f.message for f in fs)
+    src_lint.apply_fixes(root, "seedpkg")
+    assert src_lint.run_src_lint(root, "seedpkg",
+                                 rules=("keep-in-sync",)) == []
+
+
+def test_keep_in_sync_unpaired_and_unterminated(tmp_path):
+    root = _seed(tmp_path, {
+        "solo.py": (f"# {_MARK}(loner)\n# body\n# {_MARK}-END(loner)\n"),
+        "open.py": f"# {_MARK}(never)\n# body\n",
+    })
+    keys = _keys(src_lint.run_src_lint(root, "seedpkg",
+                                       rules=("keep-in-sync",)))
+    assert "keep-in-sync:loner:unpaired" in keys
+    assert "keep-in-sync:never:unterminated" in keys
+
+
+# --- waiver machinery -------------------------------------------------------
+
+def test_waiver_validation_staleness_and_budget(tmp_path):
+    from distributedtensorflowexample_tpu.analysis import Finding
+    wpath = str(tmp_path / "waivers.json")
+    with open(wpath, "w") as f:
+        json.dump({"waivers": [
+            {"key": "env-registry:LIVE", "reason": "r", "date":
+             "2026-08-04"},
+            {"key": "env-registry:GONE", "reason": "r", "date":
+             "2026-08-04"},
+            {"key": "env-registry:NODATE", "reason": "r"},
+            {"key": "hlo-budget:zero9:x", "reason": "r", "date":
+             "2026-08-04"},
+        ]}, f)
+    waivers, wfs = load_waivers(wpath)
+    assert _keys(wfs) == ["waiver-invalid:2"]      # the dateless one
+    live = Finding("env-registry", "p.py", 1, "env-registry:LIVE", "m")
+    unwaived, waived, stale = apply_waivers(
+        [live], waivers, ran_rules={"env-registry"})
+    assert unwaived == [] and _keys(waived) == ["env-registry:LIVE"]
+    # GONE is stale (its rule ran, nothing matched); the hlo waiver is
+    # NOT judged stale — that front did not run.
+    assert _keys(stale) == ["waiver-stale:env-registry:GONE"]
+    # Budget: more than WAIVER_BUDGET well-formed waivers is a finding.
+    many = [{"key": f"k:{i}", "reason": "r", "date": "2026-08-04"}
+            for i in range(WAIVER_BUDGET + 1)]
+    with open(wpath, "w") as f:
+        json.dump({"waivers": many}, f)
+    _, wfs = load_waivers(wpath)
+    assert _keys(wfs) == ["waiver-budget"]
+
+
+# --- the repo gate (tier-1 wiring) ------------------------------------------
+
+def test_repo_src_lint_is_clean_under_checked_in_waivers():
+    """THE inline tier-1 gate: the full source front over the real repo
+    must report zero unwaived findings given the checked-in waiver
+    file.  Breaking an invariant (an undeclared env knob, a bare
+    flag-bearing ValueError, obs/ importing numpy, marker drift) fails
+    the suite right here."""
+    findings = src_lint.run_src_lint(REPO, PKG)
+    waivers, wfs = load_waivers(waivers_path(REPO, PKG))
+    assert wfs == [], [f.message for f in wfs]
+    assert len(waivers) <= WAIVER_BUDGET
+    unwaived, _waived, stale = apply_waivers(
+        findings, waivers, ran_rules=set(src_lint.SRC_RULES))
+    assert unwaived == [], "\n".join(
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in unwaived)
+    assert stale == [], [f.key for f in stale]
+
+
+def test_graftlint_cli_src_front_and_seeded_exit_codes(tmp_path):
+    """CLI smokes: `python -m tools.graftlint --front src` exits 0 on
+    the repo and 1 on a seeded violation; --json carries the finding."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--front", "src"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout
+
+    root = _seed(tmp_path, {
+        "seedpkg/m.py": 'import os\nX = os.environ.get("SEED_NOPE")\n'})
+    out = subprocess.run(
+        [sys.executable, GRAFTLINT, "--front", "src", "--root", root,
+         "--package", "seedpkg", "--json", "-"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 1, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert not payload["ok"]
+    assert any(f["key"] == "env-registry:SEED_NOPE"
+               for f in payload["unwaived"])
+
+
+# --- HLO contract rules on synthetic text -----------------------------------
+
+_ALIASED_HEADER = ("HloModule seeded, is_scheduled=true, "
+                   "input_output_alias={ {0}: (0, {}, may-alias) }")
+
+
+def _hlo(body_lines, header=_ALIASED_HEADER, params="p0: f32[8]"):
+    body = "\n".join(f"  {ln}" for ln in body_lines)
+    return (f"{header}\n\nENTRY %main ({params}) -> f32[8] {{\n"
+            f"  %p0 = f32[8]{{0}} parameter(0)\n{body}\n"
+            f"  ROOT %r = f32[8]{{0}} add(f32[8]{{0}} %p0, "
+            f"f32[8]{{0}} %p0)\n}}\n")
+
+
+_AG = "%ag{n} = f32[8]{{0}} all-gather(f32[1]{{0}} %p0), dimensions={{0}}"
+_RS = ("%rs{n} = f32[1]{{0}} reduce-scatter(f32[8]{{0}} %p0), "
+       "dimensions={{0}}")
+
+
+def test_hlo_zero3_shape_rules_fire_on_seeded_violations():
+    from distributedtensorflowexample_tpu.analysis.hlo_lint import (
+        check_contract)
+    from distributedtensorflowexample_tpu.parallel.zero3 import (
+        HLO_CONTRACT as Z3)
+    sym = {"B": 1}
+    # Clean: AG before RS, nothing trailing.
+    ok = _hlo([_AG.format(n=0), _RS.format(n=0)])
+    assert check_contract(ok, Z3, symbols=sym) == []
+    # Violation 1: the RS precedes its AG — the prefetch inverted.
+    bad = _hlo([_RS.format(n=0), _AG.format(n=0)])
+    keys = _keys(check_contract(bad, Z3, symbols=sym))
+    assert "hlo-ag-before-rs:zero3:0" in keys
+    # Violation 2: a step-closing AG after the last RS (ZeRO-1 leak).
+    trailing = _hlo([_AG.format(n=0), _RS.format(n=0), _AG.format(n=1)])
+    keys = _keys(check_contract(trailing, Z3, symbols=sym))
+    assert "hlo-trailing-ag:zero3" in keys
+    assert any(k.startswith("hlo-budget:zero3:all-gather")
+               for k in keys)            # 2 AGs also bust the B=1 budget
+    # Violation 3: the schedule vanished entirely (zero collectives).
+    # NOT a vacuous pass: B buckets promise exactly B pairs, and the
+    # symbol-valued budgets are exact.
+    keys = _keys(check_contract(_hlo([]), Z3, symbols=sym))
+    assert "hlo-ag-before-rs:zero3:buckets" in keys
+    assert any(k.startswith("hlo-budget:zero3:") for k in keys)
+
+
+def test_hlo_zero1_pair_and_budget_rules_fire():
+    from distributedtensorflowexample_tpu.analysis.hlo_lint import (
+        check_contract)
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        ZERO1_HLO_CONTRACT as Z1)
+    sym = {"B": 1}
+    ok = _hlo([_RS.format(n=0), _AG.format(n=0)])
+    assert check_contract(ok, Z1, symbols=sym) == []
+    # Missing the update-closing AG entirely.
+    keys = _keys(check_contract(_hlo([_RS.format(n=0)]), Z1, symbols=sym))
+    assert "hlo-rs-ag-pair:zero1:count" in keys
+    # A collective outside the declared budget (an all-to-all appears).
+    a2a = ("%x = f32[8]{0} all-to-all(f32[8]{0} %p0), "
+           "dimensions={0}")
+    keys = _keys(check_contract(
+        _hlo([_RS.format(n=0), _AG.format(n=0), a2a]), Z1, symbols=sym))
+    assert "hlo-budget:zero1:all-to-all" in keys
+
+
+def test_hlo_donation_and_dtype_ceiling_rules_fire():
+    from distributedtensorflowexample_tpu.analysis.hlo_lint import (
+        check_contract)
+    contract = {"mode": "seeded", "require_alias": True,
+                "no_donated_copy": True, "dtype_ceiling": "f32"}
+    # Clean: aliased, no copies, no f64.
+    assert check_contract(_hlo([]), contract) == []
+    # No alias map at all: donation aliased nothing.
+    plain = "HloModule seeded, is_scheduled=true"
+    keys = _keys(check_contract(_hlo([], header=plain), contract))
+    assert "hlo-donation:seeded:alias" in keys
+    # Donated param copied in ENTRY.
+    cp = "%cp = f32[8]{0} copy(f32[8]{0} %p0)"
+    keys = _keys(check_contract(_hlo([cp]), contract))
+    assert "hlo-donation:seeded:copy:p0" in keys
+    # A DIFFERENT instruction whose name merely extends the donated
+    # param's (%p0.1 — HLO's dotted suffixes) is not a copy of it.
+    other = ("%p0.1 = f32[8]{0} add(f32[8]{0} %p0, f32[8]{0} %p0)",
+             "%cp = f32[8]{0} copy(f32[8]{0} %p0.1)")
+    assert check_contract(_hlo(list(other)), contract) == []
+    # Upcast past the declared f32 ceiling.
+    up = "%up = f64[8]{0} convert(f32[8]{0} %p0)"
+    keys = _keys(check_contract(_hlo([up]), contract))
+    assert "hlo-dtype-ceiling:seeded:f64" in keys
+    # A misspelled ceiling must surface as a config finding, never
+    # silently disable the check.
+    bad = dict(contract, dtype_ceiling="float32")
+    keys = _keys(check_contract(_hlo([up]), bad))
+    assert "hlo-dtype-ceiling:seeded:config" in keys
+
+
+# --- the compiled mode suite (the acceptance proof) -------------------------
+
+def test_compiled_mode_suite_honors_declared_contracts():
+    """zero3's AG-before-RS prefetch (no step-closing AG) and zero1's
+    RS+AG pair are proven by HLO CONTRACT RULES on freshly compiled
+    modules — not only by the runtime golden multisets in
+    tests/test_collectives.py.  Also pins the suite's shape: a 2-bucket
+    ladder, so the pairing rules check a real schedule."""
+    from distributedtensorflowexample_tpu.analysis import hlo_lint
+    progs = hlo_lint.mode_suite()
+    assert [p["mode"] for p in progs] == [
+        "sync_dp", "bucketed_allreduce", "zero1", "zero3"]
+    by_mode = {p["mode"]: p for p in progs}
+    assert by_mode["zero3"]["symbols"]["B"] == 2      # a real ladder
+    for p in progs:
+        fs = hlo_lint.check_contract(p["hlo"], p["contract"],
+                                     symbols=p["symbols"])
+        assert fs == [], (p["mode"], [f.message for f in fs])
+    # The schedule shapes themselves, through the lint's own parser:
+    seq3 = [op for op, _ in
+            hlo_lint.collective_schedule(by_mode["zero3"]["hlo"])]
+    assert seq3.count("all-gather") == 2
+    assert seq3.count("reduce-scatter") == 2
+    ags = [i for i, op in enumerate(seq3) if op == "all-gather"]
+    rss = [i for i, op in enumerate(seq3) if op == "reduce-scatter"]
+    assert max(ags) < min(rss)       # every prefetch AG precedes every RS
+    seq1 = [op for op, _ in
+            hlo_lint.collective_schedule(by_mode["zero1"]["hlo"])]
+    first_rs = seq1.index("reduce-scatter")
+    assert "all-gather" in seq1[first_rs:]   # update-closing AG follows

@@ -20,9 +20,13 @@
 # wedges the shared tunnel (verify skill), so captures must never run
 # under a harness timeout.
 #
-# KEEP IN SYNC with tools/supervise.py _capture_tasks (the supervised
-# default path): phase set, artifact filenames, env knobs, gates.  Any
-# phase change must land in BOTH until this bash path is retired.
+# The phase table below is mirrored in tools/supervise.py
+# _capture_tasks (the supervised default path): phase set, artifact
+# filenames, env knobs, gates.  Any phase change must land in BOTH
+# until this bash path is retired — enforced by graftlint's
+# keep-in-sync rule: the digest on the marker a few lines down covers
+# both regions' content, so editing either side stales both digests
+# until you re-sync and `python -m tools.graftlint --fix` re-stamps.
 
 cd "$(dirname "$0")/.." || exit 1
 
@@ -38,6 +42,7 @@ if [ "${CAPTURE_SUPERVISED:-0}" = 1 ]; then
   exec python tools/supervise.py --capture
 fi
 
+# KEEP-IN-SYNC(capture-phases) digest=1921cee5f541
 OUT=${OUT:-BENCH_auto_r05.json}
 OUT_HEADLINE=${OUT_HEADLINE:-BENCH_headline_r05.json}
 PROFILE_OUT=${PROFILE_OUT:-PROFILE_auto_r05.json}
@@ -201,3 +206,4 @@ keep "$CLI_OUT.tmp" "$CLI_OUT"
 echo "cli out-of-box rc=$rc4 last=$(grep -o 'steps_per_sec_per_chip=[0-9.]*' \
   "$CLI_OUT" 2>/dev/null | tail -1)" >> "$LOG"
 date -u >> "$LOG"
+# KEEP-IN-SYNC-END(capture-phases)

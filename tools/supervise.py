@@ -18,8 +18,10 @@ Two modes:
   python tools/supervise.py --capture
 
 Capture mode honors bench_capture.sh's env surface (OUT, OUT_HEADLINE,
-PROFILE_OUT, BYTES_OUT, TRACE_TGZ, CLI_OUT, TRACE_DIR, LOG,
-CAPTURE_PIDFILE, BENCH_RETRY_BUDGET_S, BYTES_ARGS) and writes the SAME
+PROFILE_OUT, BYTES_OUT, COLLECTIVES_OUT, LM_OUT, TRACE_TGZ, CLI_OUT,
+TRACE_DIR, LOG, CAPTURE_PIDFILE, BENCH_RETRY_BUDGET_S, BYTES_ARGS —
+the graftlint keep-in-sync digest pins the two phase tables to each
+other) and writes the SAME
 pidfile, so tools/tpu_watch.sh's liveness/stale-kill machinery sees a
 supervised capture exactly like a bash one.  The journal
 (SUPERVISE_JOURNAL, default alongside the log) is what the bash path
@@ -74,11 +76,14 @@ def _write_pidfile(path: str) -> None:
 
 def _capture_tasks(start_ts: float,
                    full_bench_done_prior: bool = False) -> list[Task]:
-    # KEEP IN SYNC with tools/bench_capture.sh (the flagged bash
-    # fallback): phase set, artifact filenames, env knobs, gate strings.
-    # Any phase change must land in BOTH until the bash path is retired;
-    # tests/test_resilience.py::test_supervise_capture_queue_shape pins
-    # this queue's shape.
+    # Mirrored in tools/bench_capture.sh (the flagged bash fallback):
+    # phase set, artifact filenames, env knobs, gate strings.  Any
+    # phase change must land in BOTH until the bash path is retired —
+    # enforced by graftlint's keep-in-sync rule (the digest below
+    # covers both regions; `python -m tools.graftlint --fix` re-stamps
+    # after a deliberate re-sync).  tests/test_resilience.py::
+    # test_supervise_capture_queue_shape pins this queue's shape.
+    # KEEP-IN-SYNC(capture-phases) digest=1921cee5f541
     env = os.environ
     py = sys.executable
     log = env.get("LOG", "/tmp/bench_capture.log")
@@ -208,6 +213,7 @@ def _capture_tasks(start_ts: float,
              wall_timeout_s=1800.0,
              gate=fresh_measured),
     ]
+    # KEEP-IN-SYNC-END(capture-phases)
 
 
 def _capture_ended(journal_path: str) -> bool:
