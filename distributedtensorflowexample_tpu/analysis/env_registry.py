@@ -30,6 +30,11 @@ ENV_REGISTRY: dict[str, str] = {
     "FLEET_DRILL_DIE_IN_DISCARD": (
         "Drill seam: rank to SIGKILL mid-discard so the interrupted-"
         "agreement replay path stays tested (resilience/fleet.py)."),
+    "FLEET_HOST_DOWN_FILE": (
+        "Per-rank host-loss tombstone path (exported by the fleet "
+        "supervisor): the host_loss fault writes it and the next spawn "
+        "of that rank fails like a dead host (resilience/faults.py, "
+        "resilience/fleet.py)."),
     "OBS_ANOMALY_SKIP": (
         "Steps ignored at window start before the anomaly baseline "
         "arms (obs/anomaly.py; default 1 — the compile step)."),
@@ -77,6 +82,22 @@ ENV_REGISTRY: dict[str, str] = {
     "OBS_TRACE_FILE": (
         "Path to append per-process span events (JSONL) for the "
         "cross-rank timeline merge; unset = no trace (obs/trace.py)."),
+    "SCHED_DRILL_DIE_AT": (
+        "Drill seam: SIGKILL the scheduler right after it journals a "
+        "matching record (substring of 'event:action:job'), so the "
+        "write-ahead replay path stays tested "
+        "(resilience/scheduler.py)."),
+    "SCHED_QUEUE": (
+        "Default queue file for tools/schedule.py when --queue is not "
+        "passed (resilience/scheduler.py)."),
+    "SCHED_SLO_PRIORITIES": (
+        "Per-kind SLO priority overrides for the scheduler, "
+        "'kind=int,...' (lower = more urgent; default serve=0 train=10 "
+        "bench=20 drill=30; resilience/scheduler.py)."),
+    "SCHED_TICK_S": (
+        "Scheduler policy-loop cadence in seconds — the latency floor "
+        "on every reap/evict/grow/admit decision "
+        "(resilience/scheduler.py; default 0.25)."),
     "SUPERVISE_ATTEMPT": (
         "Attempt number of the supervised child, exported by the "
         "supervisor so obs rows carry retry provenance (obs/*)."),

@@ -17,6 +17,10 @@ Three cooperating pieces, each usable alone:
   heartbeats, whole-gang teardown on any rank loss, and gang restarts
   from the maximum common valid snapshot step (the resume-step
   agreement that keeps a restarted fleet bitwise-consistent).
+- :mod:`.scheduler` — the control plane over all of it: a journaled
+  multi-job queue admitted against measured cost, packed onto the
+  device mesh, with elastic shrink/grow-on-recovery and loss-free
+  SLO preemption as policy (tools/schedule.py).
 
 Everything here runs on CPU — the outage this subsystem exists for can
 never block its own tests.
@@ -24,10 +28,12 @@ never block its own tests.
 
 from distributedtensorflowexample_tpu.resilience.faults import (  # noqa: F401
     FAULT_KINDS, FaultInjectionHook, FaultPlan, FaultSpec, FaultyBatches,
-    MetricsTapeHook, NaNGuardHook, tear_journal)
+    MetricsTapeHook, NaNGuardHook, mark_host_down, tear_journal)
 from distributedtensorflowexample_tpu.resilience.fleet import (  # noqa: F401
     FleetSupervisor, GangResult, RankLossRefused,
     RankLossStructurallyIllegal, RankLostError)
+from distributedtensorflowexample_tpu.resilience.scheduler import (  # noqa: F401
+    Job, Scheduler, load_queue)
 from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401
     SnapshotHook, SnapshotStore, newest_common_step, valid_steps)
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: F401

@@ -50,6 +50,17 @@ kind                models
                     distinguishes a lost rank from a clean preemption;
                     the gang-supervision drill's "kill rank 1 at
                     step 37" (resilience/fleet.py)
+``host_loss``       a host loss, not just a process loss: the rank
+                    writes its fleet-exported tombstone
+                    (``FLEET_HOST_DOWN_FILE``, the spawn-OSError seam
+                    resilience/fleet.py checks before every spawn) and
+                    then SIGKILLs itself — the next respawn of this
+                    rank FAILS like a dead host, driving the fleet's
+                    rank-loss taxonomy (elastic shrink / refusal) as
+                    policy.  ``arg`` = seconds until the host answers
+                    again (the tombstone self-expires, so the recovery
+                    re-probe grows the gang back); 0 = down until the
+                    tombstone is removed.  ``host_loss@N:SECS%rank``
 ``slow_rank``       a PERSISTENT straggler: every step boundary from the
                     fault step onward is delayed ``arg`` seconds
                     (default 0.25) — slow-but-alive, heartbeats keep
@@ -86,6 +97,7 @@ poisons exactly the window that covers it).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import random
 import signal
@@ -103,7 +115,7 @@ from distributedtensorflowexample_tpu.training.hooks import (
 
 FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
                "torn_snapshot", "heartbeat_flap", "journal_torn", "kill",
-               "slow_rank")
+               "slow_rank", "host_loss")
 _BATCH_KINDS = ("nan_loss", "corrupt_batch")
 _POST_EXIT_KINDS = ("torn_snapshot", "journal_torn")
 
@@ -149,6 +161,11 @@ NAMED_PLANS = {
     # Mild persistent straggle from the anchor step on; pin a rank with
     # the spec grammar (slow_rank@N:SECS%RANK) for gang drills.
     "slow_rank": [("slow_rank", None, 0.25)],
+    # Rank 1's HOST dies at the anchor step and answers again 2 s later
+    # (tombstone self-expiry): the elastic shrink-then-grow scenario the
+    # scheduler's autoscaling policy drills.  Pin others / change the
+    # outage length with the grammar (host_loss@N:SECS%RANK).
+    "host_loss": [("host_loss", None, 2.0, 1)],
 }
 
 
@@ -242,6 +259,24 @@ def _mark_fired(spec: FaultSpec, step: int) -> None:
     preceded the death it documents."""
     _INJECTED.labels(kind=spec.kind).inc()
     obs_trace.event("fault", 0.0, kind=spec.kind, step=step)
+
+
+def mark_host_down(path: str, down_s: float = 0.0,
+                   rank: int | None = None) -> None:
+    """Write the host-loss tombstone (atomically — the reader must see
+    a whole record or none): ``down_s`` > 0 makes the outage self-heal
+    after that long (resilience/fleet.py removes the expired tombstone
+    at the next probe), 0 means down until the file is removed.  Split
+    out of the hook so the seam is unit-testable without SIGKILLing the
+    test process."""
+    rec = {"ts": obs_metrics._wall(), "down_s": float(down_s),
+           "rank": rank, "pid": os.getpid()}
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def tear_journal(path: str) -> bool:
@@ -358,6 +393,24 @@ class FaultInjectionHook(Hook):
                 # — recovery must come entirely from what was already on
                 # disk (the snapshot this boundary's SnapshotHook wrote
                 # before this hook fired) plus an external supervisor.
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif s.kind == "host_loss":
+                # kill's bigger sibling: the HOST goes too.  Tombstone
+                # first (the fleet's spawn-OSError seam — the respawn of
+                # this rank must fail like a dead host, for `arg`
+                # seconds), then the uncatchable SIGKILL.  Refused
+                # loudly without the seam: a "host loss" whose respawn
+                # would quietly succeed drills nothing.
+                down_file = os.environ.get("FLEET_HOST_DOWN_FILE", "")
+                if not down_file:
+                    raise ValueError(
+                        "host_loss has no tombstone seam to write "
+                        "(FLEET_HOST_DOWN_FILE unset) — run the drill "
+                        "under tools/supervise_fleet.py or "
+                        "tools/schedule.py, which export it per rank")
+                mark_host_down(
+                    down_file, down_s=s.arg,
+                    rank=int(os.environ.get("OBS_RANK", "0") or 0))
                 os.kill(os.getpid(), signal.SIGKILL)
         if self._slow_s:
             # The straggler condition: pure boundary delay, heartbeats

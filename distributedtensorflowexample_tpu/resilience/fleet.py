@@ -82,10 +82,12 @@ import dataclasses
 import json
 import os
 import random
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -112,6 +114,9 @@ _GANG_RESTARTS = obs_metrics.counter(
     "whole-gang teardown+relaunch cycles (crash-budgeted and preempted)")
 _RANKS_LOST = obs_metrics.counter(
     "fleet_ranks_lost_total", "ranks whose host could not be respawned")
+_RANKS_RECOVERED = obs_metrics.counter(
+    "fleet_ranks_recovered_total",
+    "previously lost ranks re-added by a recovery re-probe")
 _AGREEMENTS = obs_metrics.counter(
     "fleet_resume_step_agreements_total",
     "resume-step agreement passes run before a gang relaunch")
@@ -176,6 +181,7 @@ class RankLossRefused(RankLostError):
 @dataclasses.dataclass
 class GangResult:
     status: str                  # ok | exhausted | wedged | terminated
+                                 # | evicted (request_stop — no restart)
     gang_attempts: int           # launches, including the first
     restarts: int                # teardown+relaunch cycles (all causes)
     preemptions: int             # clean unanimous-143 restarts (exempt)
@@ -230,7 +236,8 @@ class FleetSupervisor:
                  skew_time_ratio: float = 4.0,
                  ledger_path: str | None = None,
                  http: bool = False,
-                 http_timeout_s: float = 0.25):
+                 http_timeout_s: float = 0.25,
+                 reprobe_on_relaunch: bool = True):
         if num_ranks < 1:
             raise ValueError(f"num_ranks {num_ranks} must be >= 1")
         self.num_ranks = num_ranks
@@ -244,6 +251,11 @@ class FleetSupervisor:
         self.preempt_grace_s = preempt_grace_s
         self.elastic = elastic
         self.worker_tiled = worker_tiled
+        # A standalone fleet regrows itself before every elastic
+        # relaunch; under the scheduler this is False — regrowing
+        # consumes mesh devices the scheduler may have backfilled, so
+        # only its capacity-gated grow policy may widen the gang.
+        self.reprobe_on_relaunch = reprobe_on_relaunch
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         # Fleet-level health.json (obs/anomaly.py contract): None means
@@ -281,6 +293,17 @@ class FleetSupervisor:
         # This fleet invocation's ledger disambiguator (see _gang_run).
         self._fleet_run_id = (f"{int(obs_metrics._wall() * 1000):x}"
                               f"-{os.getpid()}")
+        # Scheduler-driven clean stop (tools/schedule.py SLO preemption):
+        # request_stop() sets this and the monitor loop tears the gang
+        # down through the same TERM-grace-KILL path a platform
+        # preemption takes — every rank saves and exits 143 — but run()
+        # returns "evicted" instead of restarting.
+        self._stop = threading.Event()
+        self._stop_reason = "evicted"
+        # Original rank ids whose host is permanently gone (elastic
+        # shrink path); the recovery re-probe re-adds them when their
+        # host answers again — see probe_lost_ranks/reprobe_lost_ranks.
+        self._lost: set[int] = set()
         # One port per ORIGINAL rank, chosen once: a gang restart reuses
         # the same coordinator address, like a real re-scheduled job
         # whose hosts keep their endpoints.
@@ -335,6 +358,14 @@ class FleetSupervisor:
                     argv: list[str], name: str, attempt: int,
                     agreed: int | None, stdout_dir: str | None,
                     env_extra: dict | None) -> subprocess.Popen:
+        # The host-loss seam: a fresh tombstone for this rank means its
+        # host is down, and the spawn fails with the SAME OSError shape
+        # a missing/unexecable binary produces — one rank-lost path for
+        # the real failure and the drillable one (faults.py host_loss).
+        if self.host_down(rank):
+            raise OSError(
+                f"rank {rank} host is down (tombstone "
+                f"{self._host_down_path(rank)})")
         env = dict(os.environ)
         env["TF_CONFIG"] = tf_config_env(hosts, index)
         env["OBS_RANK"] = str(rank)
@@ -374,6 +405,11 @@ class FleetSupervisor:
         except OSError:
             pass
         env["OBS_HEALTH"] = hp
+        # The faults.py host_loss seam: the child writes THIS tombstone
+        # (then SIGKILLs itself), and the next spawn of this rank fails
+        # with the spawn-OSError above — a host loss, drillable from a
+        # FaultPlan like every other fault.
+        env["FLEET_HOST_DOWN_FILE"] = self._host_down_path(rank)
         if self.ledger_path:
             # setdefault: an operator pointing the whole fleet at one
             # box-wide ledger (their own OBS_LEDGER export) wins.
@@ -392,6 +428,13 @@ class FleetSupervisor:
             env.setdefault("SUPERVISE_JOURNAL", self.journal.path)
         if env_extra:
             env.update(env_extra)
+        # Write-ahead half of the spawn record: a SIGKILL landing
+        # between Popen and the pid row below would otherwise leave an
+        # orphan no sweep can find; the intent at least makes the gap
+        # visible to the sweeper (which warns — it cannot kill a pid it
+        # never learned).
+        self.journal.write("rank_spawn_intent", task=name,
+                           attempt=attempt, rank=rank)
         out = err = None
         try:
             # stderr appends across attempts (one log per rank, like the
@@ -403,11 +446,11 @@ class FleetSupervisor:
                 out = open(os.path.join(
                     stdout_dir, f"rank{rank}_attempt{attempt}.out"), "wb")
             # {num_ranks} reflects the LIVE gang size (an elastic
-            # restart shrank it), matching the FLEET_NUM_RANKS and
-            # TF_CONFIG this same spawn exports — a child sharding by
-            # the substituted value must divide by the ranks that
-            # actually exist.
-            return subprocess.Popen(
+            # restart shrank it — or a recovery re-probe grew it back),
+            # matching the FLEET_NUM_RANKS and TF_CONFIG this same
+            # spawn exports — a child sharding by the substituted value
+            # must divide by the ranks that actually exist.
+            proc = subprocess.Popen(
                 self._sub(argv, rank, len(self.ranks)), env=env,
                 stdout=out or err, stderr=err, start_new_session=True)
         finally:
@@ -415,6 +458,104 @@ class FleetSupervisor:
             for f in (out, err):
                 if f is not None:
                     f.close()
+        # The pid lands in the journal so an OUTER control plane
+        # (tools/schedule.py) that died with this gang still running can
+        # sweep the orphaned process groups on restart — a spawned rank
+        # with no matching rank_exit is exactly that orphan.
+        self.journal.write("rank_spawn", task=name, attempt=attempt,
+                           rank=rank, pid=proc.pid)
+        return proc
+
+    # --- host-loss seam + recovery re-probe -------------------------------
+    def _host_down_path(self, rank: int) -> str:
+        return os.path.join(self.workdir, f"host_down_rank{rank}")
+
+    def host_down(self, rank: int) -> bool:
+        """Is this rank's host tombstoned?  The tombstone is a JSON file
+        the host_loss fault (resilience/faults.py) writes before the
+        process SIGKILLs itself: ``down_s`` > 0 means the host comes
+        back after that long (the tombstone self-expires and is
+        removed); 0 means down until an operator removes the file.
+        Unlike the per-spawn heartbeat/health resets, the tombstone
+        deliberately SURVIVES across FleetSupervisor incarnations — a
+        re-scheduled job must still see a dead host dead."""
+        path = self._host_down_path(rank)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except OSError:
+            return False
+        except ValueError:
+            # Half-written tombstone: the host died mid-declaring its
+            # own death — still a dead host, not a healthy one.
+            return True
+        down_s = float(rec.get("down_s") or 0.0)
+        if down_s > 0 and obs_metrics._wall() - float(rec.get("ts")
+                                                     or 0.0) >= down_s:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return False
+        return True
+
+    @property
+    def lost_ranks(self) -> list[int]:
+        """Original rank ids dropped by the elastic shrink path and not
+        yet recovered — what the scheduler's grow policy watches.  Read
+        from the scheduler's tick thread while the fleet's run thread
+        mutates the set, so take one C-level copy before iterating."""
+        return sorted(set(self._lost))
+
+    def probe_lost_ranks(self, argv: list[str]) -> list[int]:
+        """Non-mutating recovery probe: which lost ranks could spawn
+        again NOW — no fresh tombstone, and the rank's substituted
+        program resolves to something executable (the exact precondition
+        of the spawn whose OSError lost the rank).  The scheduler polls
+        this each tick to drive grow-on-recovery (cross-thread — hence
+        the snapshot copy); the fleet's own retry loop calls the
+        mutating half before every elastic relaunch."""
+        out = []
+        for r in self.lost_ranks:
+            if self.host_down(r):
+                continue
+            prog = self._sub(argv, r, self.num_ranks)[0] if argv else ""
+            if not prog or shutil.which(prog) is None:
+                continue
+            out.append(r)
+        return out
+
+    def reprobe_lost_ranks(self, argv: list[str],
+                           name: str = "") -> list[int]:
+        """The recovery re-probe hook (mutating half): re-add every
+        lost rank whose host answers again, restoring the gang — and
+        the ``{num_ranks}`` substitution — to full width on the next
+        relaunch.  Journaled per rank (``rank_recovered``) and counted,
+        so a postmortem shows the shrink AND the grow."""
+        recovered = self.probe_lost_ranks(argv)
+        for r in recovered:
+            self._lost.discard(r)
+            self.ranks.append(r)
+            self.ranks.sort()
+            _RANKS_RECOVERED.inc()
+            self.journal.write("rank_recovered", task=name, rank=r,
+                               ranks=list(self.ranks))
+            self._ledger_event("rank_recovered", task=name, rank=r,
+                               ranks=list(self.ranks))
+            _log(f"{name}: rank {r} host answered the recovery re-probe "
+                 f"— gang grows back to ranks {self.ranks}")
+        return recovered
+
+    def request_stop(self, reason: str = "evicted") -> None:
+        """Scheduler-driven clean preemption: the monitor loop tears the
+        gang down through the normal TERM-grace-KILL escalation (every
+        rank's SIGTERM handler saves and exits 143) and ``run()``
+        returns status ``evicted`` WITHOUT restarting — the caller
+        (tools/schedule.py) requeues the job, and its next launch
+        resumes from the snapshots this stop produced.  Thread-safe:
+        the scheduler calls it from outside the fleet's run thread."""
+        self._stop_reason = reason
+        self._stop.set()
 
     # --- gang teardown ----------------------------------------------------
     def _teardown(self, procs: dict, exited: dict, why: str, name: str,
@@ -634,6 +775,12 @@ class FleetSupervisor:
         hosts = [f"127.0.0.1:{self._ports[r]}" for r in self.ranks]
         procs: dict[int, subprocess.Popen] = {}
         exited: dict[int, int | None] = {}
+        if self._stop.is_set():
+            # A stop that landed between gang attempts: don't launch a
+            # gang just to tear it down one poll later.
+            return ("evicted",
+                    f"stop requested ({self._stop_reason}) before launch",
+                    exited)
         sigterm_seen: list = []
         # Anomaly latches are per gang attempt: a restart is a new run
         # (fresh detectors in every child), so a prior attempt's
@@ -693,6 +840,7 @@ class FleetSupervisor:
                     if not self.elastic:
                         raise RankLossRefused(rank, attempt, str(e)) from e
                     self.ranks.remove(rank)
+                    self._lost.add(rank)
                     if not self.ranks:
                         raise RankLossRefused(rank, attempt, str(e)) from e
                     _log(f"{name}: rank {rank} lost ({e}); elastic — "
@@ -739,6 +887,16 @@ class FleetSupervisor:
                     self._teardown(procs, exited, "fleet_sigterm", name,
                                    attempt)
                     return "terminated", "fleet SIGTERM — forwarded", exited
+                if self._stop.is_set():
+                    # Scheduler-driven clean stop (SLO eviction / grow
+                    # relaunch): same TERM-grace-KILL teardown — the
+                    # ranks save and exit 143 — but the outcome routes
+                    # to run()'s no-restart "evicted" return.
+                    self._teardown(procs, exited, self._stop_reason,
+                                   name, attempt)
+                    return ("evicted",
+                            f"stop requested ({self._stop_reason})",
+                            exited)
                 if crashed:
                     self._teardown(procs, exited, "rank_crash", name,
                                    attempt, rank=crashed[0])
@@ -928,12 +1086,18 @@ class FleetSupervisor:
     def run(self, argv: list[str], name: str = "",
             snapshot_dir_template: str = "",
             stdout_dir: str | None = None,
-            env_extra: dict | None = None) -> GangResult:
+            env_extra: dict | None = None,
+            agree_first: bool = False) -> GangResult:
         """Supervise ``argv`` (with ``{rank}`` substitution) as an
         N-rank gang until it completes, exhausts the crash budget, or
         loses a host.  ``snapshot_dir_template`` names each rank's
         SnapshotStore directory (``{rank}`` substituted) — without it
-        no agreement pass runs and restarts are fresh-per-child."""
+        no agreement pass runs and restarts are fresh-per-child.
+        ``agree_first`` runs the agreement pass BEFORE the first launch
+        too: a RESUMED job (the scheduler relaunching an evicted gang)
+        starts from stores a previous fleet incarnation wrote, so 'the
+        first launch has nothing to agree on' no longer holds — the
+        ranks' newest steps may already diverge."""
         name = name or Supervisor._default_name(argv)
         attempt = -1
         failures = 0
@@ -947,6 +1111,8 @@ class FleetSupervisor:
         # divergent timeline the dead supervisor had condemned.
         agreed: int | None = self._replay_agreement(
             name, snapshot_dir_template)
+        if agreed is None and agree_first and snapshot_dir_template:
+            agreed = self._agree(name, snapshot_dir_template)
         agreed_steps: list = []
         reasons: list[str] = []
         last: dict = {}
@@ -978,6 +1144,16 @@ class FleetSupervisor:
                                           restarts, preemptions,
                                           agreed_steps, last,
                                           list(self.ranks), reasons)
+                    if outcome == "evicted":
+                        # request_stop(): clean preemption on the
+                        # scheduler's behalf — no restart; the caller
+                        # requeues and relaunches from the snapshots
+                        # the teardown's TERM just produced.
+                        attrs["status"] = "evicted"
+                        return GangResult("evicted", attempt + 1,
+                                          restarts, preemptions,
+                                          agreed_steps, last,
+                                          list(self.ranks), reasons)
                     if outcome == "wedged":
                         # The backend is provably gone under EVERY rank
                         # of this gang; relaunching N processes against
@@ -1002,6 +1178,13 @@ class FleetSupervisor:
                                 list(self.ranks), reasons)
                     restarts += 1
                     _GANG_RESTARTS.inc()
+                    # Grow-on-recovery: BEFORE the agreement, so a
+                    # recovered rank's store participates in (and is
+                    # trimmed by) the same pass that pins the resume
+                    # step the regrown gang exports.
+                    if self.elastic and self._lost \
+                            and self.reprobe_on_relaunch:
+                        self.reprobe_lost_ranks(argv, name)
                     agreed = self._agree(name, snapshot_dir_template)
                     agreed_steps.append(agreed)
                     if outcome not in ("preempted", "rank_lost"):

@@ -23,6 +23,11 @@ ISOLATED_FILES = [
                             # subprocess — isolated for wall time, not
                             # collective-abort risk (the fast stdlib-child
                             # fleet tests stay inline in test_fleet.py)
+    "test_sched_drill.py",  # scheduler acceptance drill: faultline jobs
+                            # (fresh jax per rank) under the control
+                            # plane — isolated for wall time; the
+                            # stdlib-child scheduler tests stay inline
+                            # in test_scheduler.py
     "test_sync_dp.py",
     "test_trainers.py",
 ]

@@ -294,6 +294,61 @@ def test_rank_lost_taxonomy(tmp_path):
                for e in _journal_events(tmp_path))
 
 
+def test_shrink_then_grow_restores_full_width(tmp_path):
+    """The grow-on-recovery satellite: rank 1's host dies (tombstone +
+    SIGKILL — the host_loss shape), the next spawn fails with the
+    spawn-OSError the tombstone seam injects, the elastic gang shrinks
+    to rank 0 and keeps working — then the tombstone expires, the
+    recovery re-probe before the next relaunch re-adds rank 1, and the
+    final gang runs at FULL width with ``{num_ranks}`` templating
+    restored to 2 (the value each child both receives in
+    FLEET_NUM_RANKS and sees substituted into its argv)."""
+    argv = _child(tmp_path, """
+import json, os, sys, time
+rank = int(os.environ["OBS_RANK"])
+n = int(os.environ["FLEET_NUM_RANKS"])
+attempt = int(os.environ["SUPERVISE_ATTEMPT"])
+print(json.dumps({"rank": rank, "n": n, "attempt": attempt,
+                  "tag": sys.argv[1]}), flush=True)
+if attempt == 0 and rank == 1:
+    with open(os.environ["FLEET_HOST_DOWN_FILE"], "w") as f:
+        json.dump({"ts": time.time(), "down_s": 0.8}, f)
+    os.kill(os.getpid(), 9)
+if n == 1:
+    time.sleep(1.0)     # outlive the tombstone so the re-probe can grow
+    sys.exit(1)         # force one more budgeted restart
+sys.exit(0)
+""") + ["w{num_ranks}"]
+    fleet = _fleet(tmp_path, elastic=True,
+                   policy=RetryPolicy(retries=4, backoff_base_s=0.01,
+                                      backoff_max_s=0.02))
+    res = fleet.run(argv, name="grow", stdout_dir=str(tmp_path / "out"))
+    assert res.status == "ok", res.reasons
+    assert res.ranks == [0, 1]          # full width again
+    assert fleet.lost_ranks == []
+    events = _journal_events(tmp_path)
+    assert any(e["event"] == "rank_lost" and e["rank"] == 1
+               for e in events)
+    rec = next(e for e in events if e["event"] == "rank_recovered")
+    assert rec["rank"] == 1 and rec["ranks"] == [0, 1]
+    # the shrunken attempt really ran at width 1, the final one at 2 —
+    # and the {num_ranks} argv templating tracked both
+    outs = {}
+    for name in os.listdir(tmp_path / "out"):
+        text = (tmp_path / "out" / name).read_text().strip()
+        if not text:
+            continue        # torn down before its first print
+        rec = json.loads(text)
+        outs[(rec["rank"], rec["attempt"])] = rec
+    shrunk = [r for r in outs.values() if r["n"] == 1]
+    assert shrunk and all(r["tag"] == "w1" and r["rank"] == 0
+                          for r in shrunk)
+    last_attempt = max(a for _, a in outs)
+    for rank in (0, 1):
+        final = outs[(rank, last_attempt)]
+        assert final["n"] == 2 and final["tag"] == "w2"
+
+
 def test_agreement_pass_exports_step_and_discards_divergence(tmp_path):
     """The restart half end-to-end: rank 0's store ran ahead (3,4,5),
     rank 1 holds (3,4) + a torn 5 — after a crash the fleet agrees on

@@ -294,24 +294,30 @@ def build_trajectory(records_dir: str) -> list[dict]:
     regeneration with unchanged records is byte-identical, so the
     checked-in artifact diffs like code."""
     rows: list[dict] = []
-    for path in sorted(glob.glob(os.path.join(records_dir,
-                                              "BENCH_*.json"))):
-        if os.path.basename(path) == _TRAJECTORY_NAME:
-            continue        # never its own source
-        recs = load_records([path])
-        if not recs:
-            continue
-        metrics: dict = {}
-        platforms: set = set()
-        for rec in recs:
-            metrics[rec["metric"]] = rec.get("value")
-            platforms.add(_platform(rec))
-        rows.append({"family": _family_of(path),
-                     "round": _round_of(path),
-                     "file": os.path.basename(path),
-                     "platforms": sorted(platforms),
-                     "n_records": len(recs),
-                     "metrics": {k: metrics[k] for k in sorted(metrics)}})
+    # SCHED_* is the scheduler's queue-completion record family
+    # (tools/schedule.py --record): the same metric-row dialect as the
+    # bench families, so the control plane's throughput rides the same
+    # trajectory/ratchet surface as every other measured thing.
+    for pattern in ("BENCH_*.json", "SCHED_*.json"):
+        for path in sorted(glob.glob(os.path.join(records_dir,
+                                                  pattern))):
+            if os.path.basename(path) == _TRAJECTORY_NAME:
+                continue        # never its own source
+            recs = load_records([path])
+            if not recs:
+                continue
+            metrics: dict = {}
+            platforms: set = set()
+            for rec in recs:
+                metrics[rec["metric"]] = rec.get("value")
+                platforms.add(_platform(rec))
+            rows.append({"family": _family_of(path),
+                         "round": _round_of(path),
+                         "file": os.path.basename(path),
+                         "platforms": sorted(platforms),
+                         "n_records": len(recs),
+                         "metrics": {k: metrics[k]
+                                     for k in sorted(metrics)}})
     for path in sorted(glob.glob(os.path.join(records_dir,
                                               "SCALING_*.json"))):
         metrics = _scaling_metrics(path)

@@ -11,6 +11,9 @@ record families: list runs, diff two runs, render metric trajectories.
   python tools/obs_query.py show --ledger RUNS.jsonl 19fc2-1234
   # config + metric deltas between two runs (id prefixes resolve):
   python tools/obs_query.py diff --ledger RUNS.jsonl 19fc2 19fd8
+  # why did the scheduler preempt/shrink/quarantine this job
+  # (tools/schedule.py's sched_* decision rows, ledger-only):
+  python tools/obs_query.py why bench1 --ledger /tmp/sched/RUNS.jsonl
   # the bench trajectory, per family per round:
   python tools/obs_query.py trajectory --format md
 
@@ -226,6 +229,140 @@ def cmd_diff(args) -> int:
     return 0
 
 
+# --- why (scheduler decisions) ---------------------------------------------
+
+# Renderers for the scheduler's sched_* ledger rows — one entry per
+# decision class resilience/scheduler.py can write; unknown sched_*
+# rows render generically rather than being dropped, so a reader never
+# loses a decision to version skew.
+# KEEP-IN-SYNC(sched-events) digest=d37469a5064a
+_WHY_RENDER = {
+    "sched_submit": lambda r: (
+        f"submitted: kind={r.get('kind')}, priority={r.get('priority')}, "
+        f"{r.get('ranks')} rank(s), retry budget {r.get('retries')}"),
+    "sched_admit": lambda r: (
+        "admitted — "
+        + (f"predicted cost {r.get('predicted_s')}s "
+           f"({r.get('step_time_s')}s/step, source {r.get('source')})"
+           if r.get("predicted_s") is not None else
+           f"step time {r.get('step_time_s')}s/step (source "
+           f"{r.get('source')}), total unknown (no steps declared)"
+           if r.get("source") else
+           "cost unknown (no trajectory family, no declared estimate)")),
+    "sched_refuse": lambda r: f"REFUSED at admission: {r.get('why')}",
+    "sched_place": lambda r: (
+        f"placed on {r.get('ranks')} of {r.get('devices')} device(s) "
+        f"(attempt {r.get('attempt')}"
+        + (", resuming from snapshots" if r.get("resumed") else "")
+        + (f", wall deadline {r.get('wall_timeout_s')}s"
+           if r.get("wall_timeout_s") else "") + ")"),
+    "sched_shrink": lambda r: (
+        f"elastic SHRINK to {r.get('ranks')} rank(s) (was "
+        f"{r.get('was')}; lost rank(s) {r.get('lost')} — host down)"),
+    "sched_grow": lambda r: (
+        f"GROW back to full width: "
+        + (f"rank(s) {r.get('recovered')} answered the recovery "
+           f"re-probe — stopped cleanly (rcs {r.get('rcs')}) and "
+           f"requeued at full width" if r.get("recovered") is not None
+           else f"{r.get('ranks')} rank(s) (was {r.get('was')}, "
+                f"fleet-internal re-probe)")),
+    "sched_evict": lambda r: (
+        f"EVICTED: {r.get('why')} — TERM→143→snapshot "
+        f"(rcs {r.get('rcs')}, clean={r.get('clean')}); requeued, "
+        f"not charged to the retry budget"),
+    "sched_retry": lambda r: (
+        f"retry {r.get('retry')}/{r.get('of')} with "
+        f"{r.get('backoff_s')}s backoff: {r.get('why')}"),
+    "sched_quarantine": lambda r: (
+        f"QUARANTINED (rcs {r.get('rcs')}): {r.get('why')}"),
+    "sched_fail": lambda r: (
+        f"FAILED after {r.get('retries')} retr(ies): {r.get('why')}"),
+    "sched_done": lambda r: (
+        f"done: rcs {r.get('rcs')} over {r.get('gang_attempts')} gang "
+        f"attempt(s), {r.get('restarts')} gang restart(s), "
+        f"{r.get('preempt_resumes')} scheduler preemption-resume(s)"),
+    "sched_orphan_killed": lambda r: (
+        f"restart swept orphaned rank {r.get('rank')} group (pid "
+        f"{r.get('pid')}) left by a dead scheduler incarnation"),
+    "sched_queue_done": lambda r: (
+        f"queue drained: {r.get('status')} {r.get('counts')}"),
+}
+# KEEP-IN-SYNC-END(sched-events)
+
+_TERMINAL_WHY = {"sched_done": "completed", "sched_fail": "failed",
+                 "sched_quarantine": "quarantined",
+                 "sched_refuse": "refused"}
+
+
+def why_rows(rows: list[dict], token: str) -> tuple[str, list[dict]]:
+    """Resolve ``token`` (exact id or unique prefix) against the
+    distinct job ids in the ledger's sched_* rows; return (job_id,
+    that job's rows in ledger order)."""
+    sched = [r for r in rows
+             if str(r.get("event", "")).startswith("sched_")
+             and r.get("job")]
+    jobs = []
+    for r in sched:
+        if r["job"] not in jobs:
+            jobs.append(r["job"])
+    if token in jobs:
+        job = token
+    else:
+        matches = [j for j in jobs if str(j).startswith(token)]
+        if len(matches) != 1:
+            raise SystemExit(
+                f"obs_query: job {token!r} "
+                + ("is ambiguous: " + ", ".join(map(str, matches))
+                   if matches else
+                   f"not found — jobs with scheduler rows: "
+                   f"{', '.join(map(str, jobs)) or '(none)'}"))
+        job = matches[0]
+    return job, [r for r in sched if r["job"] == job]
+
+
+def cmd_why(args) -> int:
+    rows, torn = obs_ledger.read_rows(args.ledger)
+    job, mine = why_rows(rows, args.job)
+    lines = []
+    for r in mine:
+        render = _WHY_RENDER.get(r.get("event"))
+        text = (render(r) if render else
+                f"{r.get('event')}: " + json.dumps(
+                    {k: v for k, v in r.items()
+                     if k not in ("v", "ts", "event", "src", "job")},
+                    sort_keys=True, default=str))
+        lines.append({"ts": r.get("ts"), "event": r.get("event"),
+                      "text": text})
+    evictions = sum(1 for r in mine if r.get("event") == "sched_evict")
+    shrinks = sum(1 for r in mine if r.get("event") == "sched_shrink")
+    grows = sum(1 for r in mine if r.get("event") == "sched_grow")
+    last_terminal = next(
+        (r for r in reversed(mine) if r.get("event") in _TERMINAL_WHY),
+        None)
+    verdict = []
+    if evictions:
+        for_jobs = sorted({str(r.get("for_job")) for r in mine
+                           if r.get("event") == "sched_evict"})
+        verdict.append(f"preempted {evictions}x (for "
+                       + ", ".join(f"`{j}`" for j in for_jobs) + ")")
+    if shrinks:
+        verdict.append(f"shrank {shrinks}x on rank loss")
+    if grows:
+        verdict.append(f"grew back {grows}x on recovery")
+    verdict.append(
+        f"finally {_TERMINAL_WHY[last_terminal['event']]}"
+        if last_terminal else "no terminal decision on record "
+                              "(still queued/running, or the ledger "
+                              "predates the end)")
+    md = [f"# Why — job `{job}`", ""]
+    md += [f"- [{l['ts']}] {l['text']}" for l in lines]
+    md += ["", f"**Verdict**: {'; '.join(verdict)}."]
+    _emit({"job": job, "timeline": lines,
+           "verdict": "; ".join(verdict), "torn": torn},
+          "\n".join(md), args.format)
+    return 0
+
+
 # --- trajectory ------------------------------------------------------------
 
 def cmd_trajectory(args) -> int:
@@ -280,6 +417,14 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("run_a")
     sp.add_argument("run_b")
     sp.set_defaults(fn=cmd_diff)
+
+    sp = sub.add_parser("why", help="one job's scheduler decision "
+                                    "timeline: why was it preempted / "
+                                    "shrunk / quarantined")
+    add_common(sp)
+    sp.add_argument("job", help="job id (or unique prefix) from "
+                                "tools/schedule.py's queue")
+    sp.set_defaults(fn=cmd_why)
 
     sp = sub.add_parser("trajectory", help="per-family per-round bench "
                                            "metric trajectories")

@@ -28,7 +28,14 @@ Exit codes extend the supervisor protocol: 0 ok, 143 terminated
 (SIGTERM forwarded to every rank group), 3 wedged (some rank reported
 the backend provably gone), 4 rank lost + worker-tiled state (restart
 with fewer workers is structurally illegal), 5 rank lost + refused
-without --elastic, 1 crash budget exhausted.  OBS_PROM_DIR (optional)
+without --elastic, 1 crash budget exhausted.  With --elastic a lost
+rank shrinks the gang; the recovery re-probe before every relaunch
+grows it back to full width once the host answers again — drill the
+whole cycle with the host_loss fault (``--plan 'host_loss@5:30%1'``:
+rank 1's host dies at step 5, answers 30 s later; the fleet exports
+the FLEET_HOST_DOWN_FILE tombstone seam per rank).  The multi-job
+layer above this — queueing, SLO preemption, cost-priced admission —
+is ``python -m tools.schedule`` (resilience/scheduler.py).  OBS_PROM_DIR (optional)
 receives a fleet.prom textfile-collector export after every gang
 attempt; per-rank flight files land in OBS_DIR (default
 <workdir>/flight) as flight_<rank>_<pid>.json — render with
