@@ -98,6 +98,29 @@ ENV_REGISTRY: dict[str, str] = {
         "Scheduler policy-loop cadence in seconds — the latency floor "
         "on every reap/evict/grow/admit decision "
         "(resilience/scheduler.py; default 0.25)."),
+    "SERVE_LOAD_CLIENTS": (
+        "Default closed-loop client thread count for serve_lm --drive "
+        "and bench_serving.py sweeps (serving/loadgen.py; default 2)."),
+    "SERVE_LOAD_REQUESTS": (
+        "Default request count one drive/bench point issues "
+        "(serving/loadgen.py; default 16)."),
+    "SERVE_PORT": (
+        "Request-front port for the serving worker's POST /generate + "
+        "GET /stats HTTP API; 0/unset = in-process only "
+        "(serving/frontend.py — distinct from OBS_HTTP_PORT, the "
+        "read-only telemetry scrape)."),
+    "SERVE_SLO_MS": (
+        "End-to-end latency SLO in ms driving serving admission: a "
+        "queued request predicted to finish past it is rejected loudly "
+        "instead of admitted to miss; 0 = admit everything "
+        "(serving/queue.py)."),
+    "SERVE_SLOTS": (
+        "Default concurrent decode slots for the serving worker "
+        "(serving/engine.py; default 4)."),
+    "SERVE_SNAPSHOT": (
+        "Default SnapshotStore directory tools/serve_lm.py and "
+        "bench_serving.py promote when --snapshot is not passed "
+        "(serving/promote.py)."),
     "SUPERVISE_ATTEMPT": (
         "Attempt number of the supervised child, exported by the "
         "supervisor so obs rows carry retry provenance (obs/*)."),

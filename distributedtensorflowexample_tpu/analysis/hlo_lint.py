@@ -7,7 +7,9 @@ only by runtime golden multisets in tests (arXiv:2004.13336's schedule
 as folklore).  This module makes each a declarative CONTRACT checked
 against compiled-HLO text: the modules that build the schedules declare
 what their compiled form must look like (``HLO_CONTRACT`` next to the
-code in ``parallel/{sync,bucketing,zero3}.py``), and
+code in ``parallel/{sync,bucketing,zero3}.py``, and the serving decode
+step's ``DECODE_HLO_CONTRACT`` in ``serving/engine.py`` — KV-cache
+donation aliased, no donated-buffer copy, no collectives), and
 :func:`check_contract` proves it on any program text — a freshly
 compiled step, a checked-in artifact, or the synthetic violations
 tests/test_analysis.py plants.
@@ -417,11 +419,33 @@ def mode_suite(bucket_bytes: int = 16 << 10) -> list[dict]:
     return out
 
 
+def serving_suite() -> list[dict]:
+    """Compile the serving decode step (lm_tiny, a small slot/cache
+    geometry — the contract is about STRUCTURE: donation aliasing, no
+    donated-parameter copy, no collectives, the f32 ceiling; none of it
+    scales with geometry) and pair it with the contract declared next
+    to the step builder (serving/engine.DECODE_HLO_CONTRACT)."""
+    import jax.numpy as jnp
+    import optax
+
+    from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.serving.engine import (
+        DECODE_HLO_CONTRACT, DecodeEngine)
+    from distributedtensorflowexample_tpu.training.state import TrainState
+
+    model = build_model("lm_tiny")
+    state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
+                              jnp.zeros((1, 8), jnp.int32))
+    engine = DecodeEngine(model, state.params, slots=2, cache_len=16)
+    return [{"mode": "serve_decode", "hlo": engine.decode_hlo(),
+             "contract": DECODE_HLO_CONTRACT, "symbols": {}}]
+
+
 def run_hlo_lint(bucket_bytes: int = 16 << 10) -> list[Finding]:
-    """Compile the mode suite and check every program against its
-    declared contract — the graftlint HLO front."""
+    """Compile the mode suite + the serving decode step and check every
+    program against its declared contract — the graftlint HLO front."""
     findings: list[Finding] = []
-    for prog in mode_suite(bucket_bytes=bucket_bytes):
+    for prog in mode_suite(bucket_bytes=bucket_bytes) + serving_suite():
         findings += check_contract(prog["hlo"], prog["contract"],
                                    symbols=prog["symbols"])
     return findings

@@ -181,7 +181,10 @@ class Job:
     env: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
-        if not self.job or os.sep in self.job or self.job != self.job.strip():
+        if (not self.job or os.sep in self.job
+                or (os.altsep and os.altsep in self.job)
+                or self.job in (".", "..")
+                or self.job != self.job.strip()):
             raise ValueError(f"job id {self.job!r} must be a non-empty "
                              f"path-safe token")
         if self.ranks < 1:

@@ -1,0 +1,399 @@
+"""The decode engine: one donate-and-reuse compiled step over a
+preallocated per-slot KV-cache.
+
+Training computes every position of every sequence each step; serving
+generates one token per live request per step, so the arithmetic that
+matters is (a) the prompt's one-time *prefill* (full causal attention,
+exactly the training forward) and (b) the steady-state *decode* step: a
+single-query attention against the K/V rows every earlier position
+already produced.  This module keeps those rows resident — two
+``[L, S, T, H, Dh]``-shaped buffers, one slot per concurrently-decoding
+request — and compiles ONE decode step whose cache arguments are
+DONATED: XLA aliases the updated cache onto the input buffers
+(``input_output_alias`` in the compiled header), so steady-state decode
+allocates nothing cache-shaped per step.  That claim is not folklore —
+:data:`DECODE_HLO_CONTRACT` is declared next to the step builder and
+checked on freshly compiled text by graftlint's HLO front
+(``analysis/hlo_lint.py``), the same way the ZeRO schedules are pinned.
+
+Numerics: the serving modules mirror ``models/transformer_lm.py``
+sub-module for sub-module — same flax layers, same names (so a training
+param tree binds directly), same explicit batched einsums with the same
+contraction dims, softmax in f32, logits in f32.  A single-query decode
+attends over masked cache rows whose ``-1e9`` scores underflow to
+exactly 0.0 after the f32 exp, so the engine's greedy tokens are
+token-for-token IDENTICAL to teacher-forced greedy decoding through the
+training model (pinned in tests/test_serving.py) — and because every
+slot's math is batch-dim-independent (einsums batch over slots,
+LayerNorm is per-row), a request's output does not depend on what the
+other slots are doing.  Continuous batching is therefore free of
+cross-request contamination *by construction*, and the mid-decode
+admission test asserts bitwise-equal output against a solo run.
+
+Out-of-vocab requests never reach the device: admission refuses them by
+name (``refusal.ModeRefusal``, serving/queue.py) — the training-side
+NaN-poison exists to catch corruption mid-flight, but a live batch must
+not be poisoned by one bad request.
+"""
+
+from __future__ import annotations
+
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedtensorflowexample_tpu.models.transformer_lm import (
+    TransformerLM)
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+
+#: The decode step's compiled-HLO contract (graftlint HLO front,
+#: analysis/hlo_lint.py `serving_suite`): the KV-cache donation actually
+#: aliased (require_alias) and no ENTRY copy of a donated cache buffer
+#: (no_donated_copy) — together, the "steady-state decode reallocates
+#: nothing cache-shaped" claim; no collective may appear (decode is a
+#: single-device program today — an exact 0 budget makes ANY collective
+#: a finding); no float wider than f32 anywhere (the f32
+#: softmax/logits ceiling the training models hold).
+DECODE_HLO_CONTRACT = {
+    "mode": "serve_decode",
+    "require_alias": True,
+    "no_donated_copy": True,
+    "collective_budget": {"all-reduce": 0},
+    "dtype_ceiling": "f32",
+}
+
+#: Default decode-slot count (SERVE_SLOTS overrides): enough concurrency
+#: to show continuous batching on the CPU demo without compiling a wide
+#: program tier-1 never fills.
+DEFAULT_SLOTS = 4
+
+
+def serve_slots_default() -> int:
+    """``SERVE_SLOTS``: default concurrent decode slots for
+    tools/serve_lm.py and bench_serving.py (CLI flags override)."""
+    try:
+        return max(1, int(os.environ.get("SERVE_SLOTS", "")))
+    except ValueError:
+        return DEFAULT_SLOTS
+
+
+class ServingBlock(nn.Module):
+    """One decoder block with the training block's exact sub-module
+    names (``ln1``/``qkv``/``attn_out``/``ln2``/``mlp_in``/``mlp_out``)
+    so the training param tree binds unchanged, and two methods:
+    :meth:`prefill` (full causal attention — the training forward's
+    einsums verbatim, plus the K/V it produced) and :meth:`decode`
+    (single-query attention against the slot's cache rows)."""
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")
+        self.qkv = nn.Dense(3 * self.d_model, dtype=self.dtype,
+                            name="qkv")
+        self.attn_out = nn.Dense(self.d_model, dtype=self.dtype,
+                                 name="attn_out")
+        self.ln2 = nn.LayerNorm(dtype=self.dtype, name="ln2")
+        self.mlp_in = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_in")
+        self.mlp_out = nn.Dense(self.d_model, dtype=self.dtype,
+                                name="mlp_out")
+
+    def _mlp(self, x):
+        h = self.ln2(x)
+        h = self.mlp_in(h)
+        h = nn.gelu(h)
+        h = self.mlp_out(h)
+        return x + h
+
+    def prefill(self, x):
+        """x [B, P, d] -> (x', k [B, P, H, Dh], v [B, P, H, Dh])."""
+        B, P, _ = x.shape
+        Dh = self.d_model // self.n_heads
+        h = self.ln1(x)
+        qkv = self.qkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, P, self.n_heads, Dh)
+        k = k.reshape(B, P, self.n_heads, Dh)
+        v = v.reshape(B, P, self.n_heads, Dh)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.asarray(
+            Dh ** 0.5, self.dtype)
+        causal = (jnp.arange(P)[:, None] >= jnp.arange(P)[None, :])
+        scores = jnp.where(causal[None, None], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(self.dtype)
+        att = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, P, -1)
+        x = x + self.attn_out(att)
+        return self._mlp(x), k, v
+
+    def decode(self, x, ck, cv, pos):
+        """One token per slot: x [S, d], cache rows ck/cv [S, T, H, Dh],
+        pos [S] (the row this step writes, = each slot's sequence
+        length so far).  The new K/V scatter at ``pos`` precedes the
+        attention read, so the current token attends to itself like the
+        training forward's diagonal; rows past ``pos`` are masked to
+        -1e9, which the f32 exp maps to exactly 0.0 — stale cache
+        content beyond a slot's frontier can never leak into its
+        output."""
+        S, T = ck.shape[0], ck.shape[1]
+        Dh = self.d_model // self.n_heads
+        h = self.ln1(x)
+        qkv = self.qkv(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, self.n_heads, Dh)
+        k = k.reshape(S, self.n_heads, Dh)
+        v = v.reshape(S, self.n_heads, Dh)
+        sl = jnp.arange(S)
+        ck = ck.at[sl, pos].set(k)
+        cv = cv.at[sl, pos].set(v)
+        scores = jnp.einsum("shd,sthd->sht", q, ck) / jnp.asarray(
+            Dh ** 0.5, self.dtype)
+        live = (jnp.arange(T)[None, :] <= pos[:, None])     # [S, T]
+        scores = jnp.where(live[:, None], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(self.dtype)
+        att = jnp.einsum("sht,sthd->shd", probs, cv).reshape(S, -1)
+        x = x + self.attn_out(att)
+        return self._mlp(x), ck, cv
+
+
+class ServingLM(nn.Module):
+    """The decode-side TransformerLM: same top-level names (``embed``,
+    ``pos``, ``block{i}``, ``ln_f``) and weight-tied f32 logits, with
+    prefill/decode methods instead of the training ``__call__``.
+    ``max_len`` must equal the TRAINING model's (it is the positional
+    table's row count — a param shape, not a serving knob; the serving
+    cache length is the engine's separate ``cache_len``)."""
+    vocab_size: int
+    n_layers: int
+    d_model: int
+    n_heads: int
+    d_ff: int
+    max_len: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.d_model,
+                              dtype=self.dtype, name="embed")
+        self.pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                            name="pos")
+        self.blocks = [ServingBlock(self.d_model, self.n_heads,
+                                    self.d_ff, self.dtype,
+                                    name=f"block{i}")
+                       for i in range(self.n_layers)]
+        self.ln_f = nn.LayerNorm(dtype=self.dtype, name="ln_f")
+
+    def prefill(self, tokens):
+        """tokens [1, P] -> (logits [1, P, V] f32,
+        k [L, P, H, Dh], v [L, P, H, Dh])."""
+        P = tokens.shape[1]
+        x = self.embed(tokens)
+        x = x + self.pos(jnp.arange(P, dtype=jnp.int32))[None]
+        ks, vs = [], []
+        for blk in self.blocks:
+            x, k, v = blk.prefill(x)
+            ks.append(k[0])
+            vs.append(v[0])
+        x = self.ln_f(x)
+        logits = self.embed.attend(x).astype(jnp.float32)
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def decode(self, tok, positions, ck, cv):
+        """tok [S], positions [S], caches [L, S, T, H, Dh] ->
+        (logits [S, V] f32, ck, cv)."""
+        x = self.embed(tok) + self.pos(positions)
+        new_k, new_v = [], []
+        for i, blk in enumerate(self.blocks):
+            x, k_i, v_i = blk.decode(x, ck[i], cv[i], positions)
+            new_k.append(k_i)
+            new_v.append(v_i)
+        ck = jnp.stack(new_k)
+        cv = jnp.stack(new_v)
+        x = self.ln_f(x)
+        logits = self.embed.attend(x).astype(jnp.float32)
+        return logits, ck, cv
+
+
+def serving_lm_for(model: TransformerLM) -> ServingLM:
+    """The serving twin of a training model — every architecture field
+    copied, so the training param tree binds bit-for-bit."""
+    return ServingLM(vocab_size=model.vocab_size,
+                     n_layers=model.n_layers, d_model=model.d_model,
+                     n_heads=model.n_heads, d_ff=model.d_ff,
+                     max_len=model.max_len, dtype=model.dtype)
+
+
+def _prefill_buckets(cache_len: int, smallest: int = 8) -> tuple:
+    """Padding buckets for prefill: powers of two from ``smallest`` up
+    to ``cache_len`` (inclusive as the final bucket).  Each bucket is
+    one compiled prefill program; a prompt pads to the smallest bucket
+    that fits, so N distinct prompt lengths cost log(N) compiles, not
+    N."""
+    out = []
+    b = smallest
+    while b < cache_len:
+        out.append(b)
+        b *= 2
+    out.append(cache_len)
+    return tuple(out)
+
+
+class DecodeEngine:
+    """Slots + caches + the two compiled programs (bucketed prefill,
+    the donated decode step).  Host-side bookkeeping (which slot is
+    live, each request's tokens) belongs to the ContinuousBatcher; this
+    class owns only the device state and refuses geometry it cannot
+    serve.
+
+    Donation discipline: both programs donate the cache buffers, so
+    after every call the PREVIOUS cache handles are dead — the engine
+    always rebinds, and no caller ever holds a cache reference."""
+
+    def __init__(self, model: TransformerLM, params, *,
+                 slots: int = DEFAULT_SLOTS, cache_len: int = 128,
+                 prefill_smallest: int = 8):
+        if cache_len > model.max_len:
+            raise ModeRefusal(
+                f"--max_len {cache_len} exceeds the model's positional "
+                f"table ({model.max_len} rows) — the snapshot was "
+                f"trained with max_len {model.max_len}; a longer cache "
+                f"would index past the table, not extrapolate it")
+        if slots < 1:
+            raise ValueError(f"slots {slots} must be >= 1")
+        self.model = model
+        self.smodel = serving_lm_for(model)
+        self.params = params
+        self.slots = int(slots)
+        self.cache_len = int(cache_len)
+        self.vocab = int(model.vocab_size)
+        self.buckets = _prefill_buckets(self.cache_len, prefill_smallest)
+        L = model.n_layers
+        H = model.n_heads
+        Dh = model.d_model // H
+        shape = (L, self.slots, self.cache_len, H, Dh)
+        self._ck = jnp.zeros(shape, model.dtype)
+        self._cv = jnp.zeros(shape, model.dtype)
+        self.cache_bytes = 2 * int(np.prod(shape)) * \
+            np.dtype(model.dtype).itemsize
+        # Host-owned scalars-per-slot, uploaded per call (tiny): the
+        # returned next-token array is the only per-step device output
+        # besides the aliased caches.
+        self.positions = np.zeros((self.slots,), np.int32)
+        self.last_tokens = np.zeros((self.slots,), np.int32)
+        self.decode_steps = 0
+        self.prefills = 0
+        # Which prefill buckets have compiled: the first call per
+        # bucket pays the jit compile, and callers timing prefill for
+        # an admission predictor must know to exclude it.
+        self._warm_buckets: set = set()
+        self.last_prefill_was_cold = False
+
+        smodel = self.smodel
+
+        def _decode(params, ck, cv, tok, pos):
+            logits, ck, cv = smodel.apply({"params": params}, tok, pos,
+                                          ck, cv,
+                                          method=ServingLM.decode)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+        def _prefill(params, ck, cv, toks, slot, length):
+            logits, k, v = smodel.apply({"params": params}, toks,
+                                        method=ServingLM.prefill)
+            ck = jax.lax.dynamic_update_slice(ck, k[:, None],
+                                              (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v[:, None],
+                                              (0, slot, 0, 0, 0))
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                axis=0, keepdims=False)
+            return jnp.argmax(last).astype(jnp.int32), ck, cv
+
+        self._decode_fn = _decode
+        self._decode_jit = jax.jit(_decode, donate_argnums=(1, 2))
+        # One jit object; the per-bucket programs are its shape-keyed
+        # cache entries (slot + length stay traced scalars so slot
+        # choice never recompiles).
+        self._prefill_jit = jax.jit(_prefill, donate_argnums=(1, 2))
+
+    # --- the two steps ----------------------------------------------------
+    def bucket_for(self, prompt_len: int, max_new: int) -> int:
+        """Smallest padding bucket holding ``prompt_len``, refusing
+        work that cannot finish inside the cache."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len + max_new > self.cache_len:
+            raise ModeRefusal(
+                f"prompt ({prompt_len} tokens) + --max_new ({max_new}) "
+                f"exceeds the engine's --max_len cache ({self.cache_len} "
+                f"rows/slot) — the request can never finish; raise "
+                f"--max_len or shorten the request")
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise AssertionError("bucket table misses cache_len")  # unreachable
+
+    def prefill(self, slot: int, prompt: np.ndarray,
+                max_new: int = 1) -> int:
+        """Fill ``slot``'s cache rows from the prompt and return the
+        first generated token.  Pads to the chosen bucket with token 0 —
+        pad rows land in the cache beyond the slot's frontier, where the
+        decode mask excludes them until a real token overwrites each."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        P = len(prompt)
+        bucket = self.bucket_for(P, max_new)
+        self.last_prefill_was_cold = bucket not in self._warm_buckets
+        self._warm_buckets.add(bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :P] = prompt
+        tok, self._ck, self._cv = self._prefill_jit(
+            self.params, self._ck, self._cv, jnp.asarray(padded),
+            np.int32(slot), np.int32(P))
+        self.positions[slot] = P
+        self.last_tokens[slot] = int(tok)
+        self.prefills += 1
+        return int(tok)
+
+    def decode(self, busy=None) -> np.ndarray:
+        """One decode step over ALL slots (idle slots compute too — the
+        program has one static shape; their outputs are ignored and
+        their stale rows are overwritten the next time the slot is
+        live).  Returns the next token per slot and advances the BUSY
+        slots' frontiers (``busy=None`` advances all): an idle slot's
+        parked frontier must not drift toward the cache/positional-
+        table edge one row per step of everyone else's work."""
+        toks, self._ck, self._cv = self._decode_jit(
+            self.params, self._ck, self._cv, self.last_tokens,
+            self.positions)
+        out = np.asarray(toks)
+        advance = (np.ones(self.slots, bool) if busy is None
+                   else np.zeros(self.slots, bool))
+        if busy is not None:
+            advance[list(busy)] = True
+        self.last_tokens = np.where(advance, out, self.last_tokens) \
+            .astype(np.int32)
+        self.positions = self.positions + advance.astype(np.int32)
+        self.decode_steps += 1
+        return out
+
+    def set_slot(self, slot: int, last_token: int, position: int) -> None:
+        """Host bookkeeping hook (the batcher parks retired slots at
+        position 0 so their frontier never walks off the cache end)."""
+        self.last_tokens[slot] = int(last_token)
+        self.positions[slot] = int(position)
+
+    # --- the contract surface --------------------------------------------
+    def decode_hlo(self) -> str:
+        """Freshly compiled decode-step text — what graftlint's HLO
+        front checks :data:`DECODE_HLO_CONTRACT` against.  Compiled
+        from the UNDONATED argument values via a separate lowering (the
+        live step's buffers must not be consumed by a lint pass)."""
+        lowered = jax.jit(self._decode_fn,
+                          donate_argnums=(1, 2)).lower(
+            self.params, self._ck, self._cv, self.last_tokens,
+            self.positions)
+        return lowered.compile().as_text()

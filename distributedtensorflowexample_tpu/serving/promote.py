@@ -1,0 +1,203 @@
+"""Snapshot → serving promotion: the training stack's recovery format
+is the serving stack's model source.
+
+A serving worker must never trust a snapshot MORE than the supervisor
+does, so promotion goes through the exact SnapshotStore validity
+machinery (manifest-last commit, size+crc re-check, newest-valid
+fallback past a torn final write — resilience/snapshot.py): a corrupted
+newest snapshot costs one snapshot interval of model freshness, never
+the serving worker.
+
+Layout awareness: training snapshots are written in the layout the run
+trained in (``run_meta.update_layout``): plain ``tree``, ZeRO-1
+``bucket_rows`` (optimizer state as per-bucket 1/D rows), or ZeRO-3
+``zero3_rows`` (params AND optimizer state as rows).  The TRAINER
+refuses cross-layout resumes by name, because resuming must be bitwise;
+serving only needs the params, so promotion instead *materializes*:
+a row-layout snapshot restores into a row-shaped template and the full
+param tree is gathered back through the PR 12 seam
+(``Zero3Layout.materialize`` — the same jitted gather eval/export use),
+never through a second opinion about the bucket plan.
+
+The promotion template's optimizer is the repo-wide training default
+(SGD + momentum): the snapshot payload is the full
+``saveable_state_dict`` leaf list, and restoring demands a
+leaf-count-identical template even though serving discards everything
+but the params.  A snapshot written by a run with a different optimizer
+fails the leaf-count check loudly (SnapshotStore.restore's existing
+error) rather than mis-binding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+from distributedtensorflowexample_tpu.resilience.snapshot import (
+    SnapshotStore)
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+_LAYOUTS = ("tree", "bucket_rows", "zero3_rows")
+
+
+def _log(msg: str) -> None:
+    print(f"serve.promote: {msg}", file=sys.stderr, flush=True)
+
+
+def serve_snapshot_default() -> str:
+    """``SERVE_SNAPSHOT``: the snapshot directory tools/serve_lm.py and
+    bench_serving.py load when ``--snapshot`` is not passed — empty
+    means the flag is required."""
+    return os.environ.get("SERVE_SNAPSHOT", "")
+
+
+def _default_tx():
+    # The repo-wide training default (trainers, faultline, bench_lm):
+    # promotion templates must mirror what the snapshot writers ran.
+    return optax.sgd(0.1, momentum=0.9)
+
+
+@dataclasses.dataclass
+class PromotedModel:
+    """What promotion hands the engine: the full (materialized) param
+    tree plus the provenance the serving ledger rows carry."""
+    model: object               # the training TransformerLM (arch facts)
+    params: object              # full tree, layout-independent
+    step: int                   # snapshot step served
+    layout: str                 # update_layout the snapshot was written in
+    manifest: dict              # the winning snapshot's manifest
+
+
+def _template(model, tx, layout: str, meta: dict, sample_len: int):
+    """(template TrainState, zero3 layout-or-None) for a snapshot's
+    declared layout — row layouts rebuild the exact bucket geometry
+    from the manifest's recorded mesh size + bucket cap."""
+    base = TrainState.create(model, tx,
+                             jnp.zeros((1, sample_len), jnp.int32))
+    if layout == "tree":
+        return base, None
+    mesh_size = meta.get("mesh_size")
+    bucket_bytes = meta.get("bucket_bytes")
+    if not mesh_size or not bucket_bytes:
+        raise ValueError(
+            f"snapshot layout {layout!r} needs manifest meta "
+            f"mesh_size+bucket_bytes to rebuild the row geometry; this "
+            f"manifest carries {sorted(meta)} — it was not written by a "
+            f"layout-stamping writer")
+    import jax
+
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        init_bucketed_opt_state)
+    if mesh_size > len(jax.devices()):
+        raise ModeRefusal(
+            f"snapshot was written at mesh_size {mesh_size} "
+            f"(--shard_params/--shard_update rows are a function of D) "
+            f"but this process sees {len(jax.devices())} device(s) — "
+            f"materializing needs a mesh at least that wide")
+    mesh = make_mesh(int(mesh_size))
+    # The row converters shard across the mesh; the template's params
+    # must live ON it first (TrainState.create places single-device).
+    repl = jax.device_put(base.params, replicated_sharding(mesh))
+    opt = init_bucketed_opt_state(tx, repl, int(bucket_bytes), mesh)
+    if layout == "bucket_rows":
+        return base.replace(opt_state=opt), None
+    from distributedtensorflowexample_tpu.parallel.zero3 import (
+        Zero3Layout)
+    z3 = Zero3Layout(repl, int(bucket_bytes), mesh)
+    # init_rows DONATES its input; opt was built from the tree first.
+    return base.replace(opt_state=opt, params=z3.init_rows(repl)), z3
+
+
+def promote(snapshot_dir: str, size: str, *, step: int | None = None,
+            tx=None, sample_len: int = 8) -> PromotedModel:
+    """Load the newest VALID snapshot of a graft-LM ``size`` from
+    ``snapshot_dir`` and return the full serving params.
+
+    - newest-first with fallback: a torn/corrupt newest snapshot is
+      discarded (counted on ``snapshot_fallbacks_total``) and the
+      previous valid one serves — the supervisor's contract, reused;
+    - layout cross-check: a manifest stamped with a different model
+      size than requested is refused by name (binding a 4-layer tree
+      into an 8-layer template would fail anyway, but late and
+      unreadably);
+    - row layouts materialize through ``Zero3Layout.materialize``.
+    """
+    store = SnapshotStore(snapshot_dir)
+    if step is None:
+        step = store.latest_valid()
+    if step is None:
+        raise ValueError(
+            f"no valid snapshot in {snapshot_dir!r} — nothing to "
+            f"promote (run training, or serve_lm's init_if_missing "
+            f"mode for a demo-grade init)")
+    man = store.manifest(step) or {}
+    meta = man.get("meta") or {}
+    snap_model = meta.get("model")
+    if snap_model and snap_model != size:
+        raise ModeRefusal(
+            f"snapshot {step} in {snapshot_dir} was written by model "
+            f"{snap_model!r}; this worker was asked to serve --size "
+            f"{size!r} — refusing to bind across architectures")
+    layout = meta.get("update_layout", "tree")
+    if layout not in _LAYOUTS:
+        raise ValueError(f"snapshot {step} declares unknown "
+                         f"update_layout {layout!r} (one of {_LAYOUTS})")
+    model = build_model(size)
+    template, z3 = _template(model, tx or _default_tx(), layout, meta,
+                             sample_len)
+    state = store.restore(template, step=step)
+    params = z3.materialize(state.params) if z3 is not None \
+        else state.params
+    _log(f"promoted snapshot step {step} ({layout}) from "
+         f"{snapshot_dir}")
+    return PromotedModel(model=model, params=params, step=int(step),
+                         layout=layout, manifest=man)
+
+
+def init_lm_snapshot(snapshot_dir: str, size: str, seed: int = 0,
+                     sample_len: int = 8) -> int:
+    """Write a demo-grade snapshot: a seeded, untrained graft-LM state
+    in the standard store format (the serving path exercises the FULL
+    promotion machinery against it — validity checks, layout stamp,
+    fallback).  Returns the snapshot step (0).  Idempotent: an existing
+    valid snapshot wins (save() dedupes by step)."""
+    model = build_model(size)
+    state = TrainState.create(model, _default_tx(),
+                              jnp.zeros((1, sample_len), jnp.int32),
+                              seed=seed)
+    store = SnapshotStore(snapshot_dir)
+    store.save(state, cursor={"seed": seed, "step": 0},
+               meta={"model": size, "update_layout": "tree",
+                     "writer": "init_lm_snapshot"})
+    return int(state.step)
+
+
+def as_prompt(tokens, vocab: int) -> np.ndarray:
+    """Validate a request's prompt tokens on the HOST, before anything
+    reaches the device: out-of-vocab ids are refused by name — the
+    training-side OOV NaN-poison guards corruption mid-run, but a live
+    batch must never be poisoned by one bad request (the refusal is the
+    serving analog: loud, per-request, batch untouched)."""
+    arr = np.asarray(tokens)
+    if arr.ndim != 1 or arr.size == 0:
+        raise ValueError(f"prompt must be a non-empty 1-D token list, "
+                         f"got shape {arr.shape}")
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise ValueError(f"prompt tokens must be integers, got dtype "
+                         f"{arr.dtype}")
+    if int(arr.min()) < 0 or int(arr.max()) >= vocab:
+        raise ModeRefusal(
+            f"request carries out-of-vocab token id(s) (valid range "
+            f"[0, {vocab})) — refused at admission; the --size model's "
+            f"vocabulary is fixed at training time and an OOV gather "
+            f"would silently clamp into a wrong embedding row")
+    return arr.astype(np.int32)

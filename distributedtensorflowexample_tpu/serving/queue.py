@@ -1,0 +1,408 @@
+"""Request queue + continuous batcher: admission at step boundaries,
+never batch-drain.
+
+The naive serving loop forms a batch, decodes it to completion, then
+admits the next batch — so a 4-token request arriving behind a
+500-token one waits the whole long decode.  Continuous batching admits
+a new request into any OPEN slot at the next step boundary: the decode
+step's shape is static (all S slots compute every step), so joining a
+running batch costs one bucketed prefill, not a drain.  The engine's
+slot math is batch-independent by construction (serving/engine.py), so
+a mid-decode admission cannot perturb the requests already in flight —
+tests/test_serving.py pins that a request admitted mid-decode produces
+bitwise the tokens it produces solo.
+
+Admission is SLO-aware (``SERVE_SLO_MS``, 0 = off): a queued request is
+priced at admission time — wait so far + a prefill estimate + max_new x
+the decode-step EWMA — and one that can no longer finish inside the SLO
+is REJECTED loudly (counted, latency-stamped) instead of admitted to
+miss.  Under overload a closed-loop client sees fast rejections and the
+in-SLO goodput stays measurable; that rejection edge is exactly the
+knee ``bench_serving.py``'s throughput-vs-SLO curves sweep out.
+
+Shutdown is the trainer's loss-free TERM protocol, re-read for serving:
+on ``drain()`` the batcher stops admitting, decodes every in-flight
+slot to completion (bounded by each request's max_new), rejects the
+still-queued tail (outcome ``drained`` — the client's cue to retry
+against the next placement), and returns — the worker then exits 143
+with every ACCEPTED-and-admitted request answered.  Telemetry flows
+through the shared obs registry: queue depth, slot occupancy,
+tokens/sec counters, a latency histogram, and p50/p99 gauges refreshed
+from the exact host-side tape.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+from distributedtensorflowexample_tpu.serving.engine import DecodeEngine
+from distributedtensorflowexample_tpu.serving.promote import as_prompt
+
+_REQUESTS = obs_metrics.counter(
+    "serve_requests_total", "serving requests by outcome "
+    "(ok / slo_rejected / drained / refused / oov_refused / "
+    "bad_request)")
+_TOKENS = obs_metrics.counter(
+    "serve_tokens_total", "tokens generated (completed requests only)")
+_STEPS = obs_metrics.counter(
+    "serve_decode_steps_total", "compiled decode steps executed")
+_PREFILLS = obs_metrics.counter(
+    "serve_prefills_total", "bucketed prefill calls, by bucket")
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "serve_queue_depth", "requests queued, not yet admitted to a slot")
+_SLOTS_BUSY = obs_metrics.gauge(
+    "serve_slots_busy", "decode slots holding a live request")
+_LATENCY = obs_metrics.histogram(
+    "serve_latency_seconds", "request end-to-end latency (submit to "
+    "last token)")
+_P50 = obs_metrics.gauge(
+    "serve_latency_p50_ms", "rolling p50 of completed-request latency")
+_P99 = obs_metrics.gauge(
+    "serve_latency_p99_ms", "rolling p99 of completed-request latency")
+
+
+def serve_slo_ms_default() -> float:
+    """``SERVE_SLO_MS``: default end-to-end latency SLO driving
+    admission (0 = admit everything; CLI flags override)."""
+    try:
+        return float(os.environ.get("SERVE_SLO_MS", ""))
+    except ValueError:
+        return 0.0
+
+
+def percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (exact, no
+    interpolation surprises in records)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its whole lifecycle tape."""
+    rid: str
+    prompt: np.ndarray
+    max_new: int
+    submit_t: float
+    admit_t: float | None = None
+    first_token_t: float | None = None
+    done_t: float | None = None
+    outcome: str = ""           # ok | slo_rejected | drained | refused
+    error: str = ""             # the refusal text, when refused
+    tokens: list = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.done_t is None:
+            return None
+        return self.done_t - self.submit_t
+
+    def finish(self, outcome: str, now: float) -> None:
+        self.outcome = outcome
+        self.done_t = now
+        self.done.set()
+
+
+class RequestQueue:
+    """Thread-safe FIFO between submitters (loadgen threads, the HTTP
+    front) and the single batcher thread.  OOV prompts are refused at
+    ``submit`` — by name, before the queue ever sees them."""
+
+    def __init__(self, vocab: int):
+        self.vocab = vocab
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def submit(self, prompt, max_new: int, rid: str | None = None,
+               now: float | None = None) -> Request:
+        try:
+            arr = as_prompt(prompt, self.vocab)
+        except ModeRefusal:
+            _REQUESTS.labels(outcome="oov_refused").inc()
+            raise
+        except ValueError:
+            # Shape/dtype defects, not vocabulary: an operator tuning
+            # a tokenizer off the oov counter must not chase these.
+            _REQUESTS.labels(outcome="bad_request").inc()
+            raise
+        with self._cv:
+            self._seq += 1
+            req = Request(rid=rid or f"req{self._seq}", prompt=arr,
+                          max_new=int(max_new),
+                          submit_t=time.monotonic() if now is None
+                          else now)
+            if self._closed:
+                # A submit racing the drain (TERM already landed) is
+                # answered immediately — a worker on its way out must
+                # never leave a caller blocked on a request nothing
+                # will ever decode.
+                req.finish("drained", time.monotonic())
+                _REQUESTS.labels(outcome="drained").inc()
+                return req
+            self._q.append(req)
+            _QUEUE_DEPTH.set(len(self._q))
+            self._cv.notify_all()
+        return req
+
+    def close(self) -> None:
+        """Stop accepting work: every later submit is answered
+        ``drained`` synchronously (the drain path calls this FIRST, so
+        the submit/drain race cannot strand a waiter)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def pop(self) -> Request | None:
+        with self._cv:
+            req = self._q.popleft() if self._q else None
+            _QUEUE_DEPTH.set(len(self._q))
+            return req
+
+    def drain_pending(self) -> list:
+        with self._cv:
+            out = list(self._q)
+            self._q.clear()
+            _QUEUE_DEPTH.set(0)
+            return out
+
+    def wait_nonempty(self, timeout_s: float) -> bool:
+        with self._cv:
+            if self._q:
+                return True
+            self._cv.wait(timeout_s)
+            return bool(self._q)
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request | None = None
+
+
+class ContinuousBatcher:
+    """The serving loop: admit → decode → retire, one step boundary at
+    a time, on one thread (the engine's donated caches are single-
+    writer by construction — concurrency lives in the queue, never in
+    the device state)."""
+
+    def __init__(self, engine: DecodeEngine, queue: RequestQueue, *,
+                 slo_ms: float | None = None, eos_id: int | None = None,
+                 on_step=None):
+        self.engine = engine
+        self.queue = queue
+        self.slo_ms = serve_slo_ms_default() if slo_ms is None \
+            else float(slo_ms)
+        self.eos_id = eos_id
+        self.on_step = on_step          # per-boundary callback (heartbeat)
+        self._slots = [_Slot() for _ in range(engine.slots)]
+        # Step-time EWMA feeding the admission predictor; seeded on the
+        # first measured step (the compile step is excluded — it would
+        # poison the estimate ~1000x and reject everything for a while).
+        self._step_ewma_s: float | None = None
+        self._prefill_ewma_s: float | None = None
+        self.completed: list = []       # finished Requests (tape)
+        self.rejected: list = []
+        self.admitted_total = 0
+
+    # --- admission --------------------------------------------------------
+    def _predicted_latency_s(self, req: Request, now: float) -> float:
+        wait = now - req.submit_t
+        pre = self._prefill_ewma_s or 0.0
+        step = self._step_ewma_s or 0.0
+        return wait + pre + req.max_new * step
+
+    def _free_slots(self) -> list:
+        return [i for i, s in enumerate(self._slots) if s.req is None]
+
+    def _admit(self, now: float) -> None:
+        """Fill open slots from the queue head; SLO-reject requests
+        that can no longer finish in time (they would only burn slot
+        capacity to miss)."""
+        free = self._free_slots()
+        while free and len(self.queue):
+            req = self.queue.pop()
+            if req is None:
+                break
+            try:
+                # Geometry check BEFORE the slot is spent: a request
+                # that can never finish inside the cache is refused by
+                # name — one impossible request must cost itself, never
+                # the serving loop (the batcher thread has no other
+                # handler above it).
+                self.engine.bucket_for(len(req.prompt), req.max_new)
+            except ValueError as e:
+                req.error = str(e)
+                req.finish("refused", time.monotonic())
+                _REQUESTS.labels(outcome="refused").inc()
+                self.rejected.append(req)
+                continue
+            if self.slo_ms > 0 and self._predicted_latency_s(
+                    req, now) * 1000.0 > self.slo_ms:
+                req.finish("slo_rejected", time.monotonic())
+                _REQUESTS.labels(outcome="slo_rejected").inc()
+                self.rejected.append(req)
+                continue
+            slot = free.pop(0)
+            t0 = time.monotonic()
+            first = self.engine.prefill(slot, req.prompt, req.max_new)
+            dt = time.monotonic() - t0
+            # The first prefill per bucket pays the compile — a wall
+            # time ~1000x steady state that must never seed the
+            # admission predictor (a compile-poisoned EWMA under an
+            # SLO rejects everything, and with nothing admitted it
+            # never decays back: a livelock).
+            if not self.engine.last_prefill_was_cold:
+                self._prefill_ewma_s = dt \
+                    if self._prefill_ewma_s is None \
+                    else 0.8 * self._prefill_ewma_s + 0.2 * dt
+            _PREFILLS.labels(
+                bucket=self.engine.bucket_for(len(req.prompt),
+                                              req.max_new)).inc()
+            req.admit_t = req.first_token_t = time.monotonic()
+            req.tokens.append(int(first))
+            self._slots[slot].req = req
+            self.admitted_total += 1
+            # max_new == 1 finishes on the prefill's own token.
+            self._maybe_retire(slot, time.monotonic())
+        _SLOTS_BUSY.set(self.engine.slots - len(self._free_slots()))
+
+    def _maybe_retire(self, slot: int, now: float) -> bool:
+        req = self._slots[slot].req
+        if req is None:
+            return True
+        full = len(req.tokens) >= req.max_new
+        eos = self.eos_id is not None and req.tokens \
+            and req.tokens[-1] == self.eos_id
+        if not (full or eos):
+            return False
+        req.finish("ok", now)
+        _REQUESTS.labels(outcome="ok").inc()
+        _TOKENS.inc(len(req.tokens))
+        _LATENCY.observe(req.latency_s)
+        self.completed.append(req)
+        self._slots[slot].req = None
+        # Park the freed slot's frontier at 0: idle slots still compute
+        # every step, and an unbounded frontier would walk past the
+        # positional table for nothing.
+        self.engine.set_slot(slot, 0, 0)
+        if len(self.completed) % 32 == 0 or len(self.completed) < 8:
+            tape = sorted(r.latency_s for r in self.completed)
+            _P50.set(round(percentile(tape, 0.50) * 1000.0, 3))
+            _P99.set(round(percentile(tape, 0.99) * 1000.0, 3))
+        return True
+
+    # --- the loop ---------------------------------------------------------
+    def _busy(self) -> list:
+        return [i for i, s in enumerate(self._slots) if s.req is not None]
+
+    def step(self) -> int:
+        """One boundary: admit into open slots, one decode step over
+        the batch, retire finished requests.  Returns the number of
+        live slots decoded (0 = idle boundary)."""
+        now = time.monotonic()
+        self._admit(now)
+        busy = self._busy()
+        if not busy:
+            return 0
+        t0 = time.monotonic()
+        toks = self.engine.decode(busy=busy)
+        dt = time.monotonic() - t0
+        # The engine's FIRST decode step pays the compile — never let
+        # it seed the admission predictor (see the prefill comment:
+        # a compile-poisoned EWMA under an SLO is a reject-everything
+        # livelock, because nothing admitted means nothing ever decays
+        # it).  Once seeded, a 50x outlier (a recompile) is skipped.
+        if self.engine.decode_steps > 1:
+            if self._step_ewma_s is None:
+                self._step_ewma_s = dt
+            elif dt < 50 * self._step_ewma_s:
+                self._step_ewma_s = 0.8 * self._step_ewma_s + 0.2 * dt
+        _STEPS.inc()
+        now = time.monotonic()
+        for slot in busy:
+            req = self._slots[slot].req
+            req.tokens.append(int(toks[slot]))
+            self._maybe_retire(slot, now)
+        _SLOTS_BUSY.set(self.engine.slots - len(self._free_slots()))
+        if self.on_step is not None:
+            self.on_step(self)
+        return len(busy)
+
+    def run(self, should_stop=lambda: False,
+            idle_wait_s: float = 0.02) -> None:
+        """Serve until ``should_stop()`` — then drain (see module
+        docstring).  Idle boundaries block on the queue's condition
+        variable, so an idle worker burns no CPU busy-looping the
+        decode step against zero slots."""
+        while not should_stop():
+            if self.step() == 0:
+                self.queue.wait_nonempty(idle_wait_s)
+        self.drain()
+
+    def drain(self) -> None:
+        """The TERM half of loss-free teardown: stop admitting, decode
+        every in-flight request to completion, reject the queued tail
+        loudly (outcome ``drained`` — re-submittable against the next
+        placement, never silently lost)."""
+        self.queue.close()           # later submits answer 'drained'
+        now = time.monotonic()
+        for req in self.queue.drain_pending():
+            req.finish("drained", now)
+            _REQUESTS.labels(outcome="drained").inc()
+            self.rejected.append(req)
+        while self._busy():
+            busy = self._busy()
+            toks = self.engine.decode(busy=busy)
+            _STEPS.inc()
+            now = time.monotonic()
+            for slot in busy:
+                req = self._slots[slot].req
+                req.tokens.append(int(toks[slot]))
+                self._maybe_retire(slot, now)
+        _SLOTS_BUSY.set(0)
+
+    # --- stats ------------------------------------------------------------
+    def stats(self) -> dict:
+        tape = sorted(r.latency_s for r in self.completed)
+        toks = sum(len(r.tokens) for r in self.completed)
+        span = (max(r.done_t for r in self.completed)
+                - min(r.submit_t for r in self.completed)) \
+            if self.completed else 0.0
+        return {
+            "completed": len(self.completed),
+            "rejected": {
+                "slo": sum(1 for r in self.rejected
+                           if r.outcome == "slo_rejected"),
+                "refused": sum(1 for r in self.rejected
+                               if r.outcome == "refused"),
+                "drained": sum(1 for r in self.rejected
+                               if r.outcome == "drained")},
+            "tokens": toks,
+            "tokens_per_sec": round(toks / span, 3) if span else None,
+            "p50_ms": round(percentile(tape, 0.50) * 1000.0, 3),
+            "p99_ms": round(percentile(tape, 0.99) * 1000.0, 3),
+            "decode_steps": self.engine.decode_steps,
+            "prefills": self.engine.prefills,
+            "slo_ms": self.slo_ms,
+            "slots": self.engine.slots,
+            "step_ewma_ms": (round(self._step_ewma_s * 1000.0, 3)
+                             if self._step_ewma_s else None),
+        }

@@ -76,6 +76,8 @@ def test_job_validation_is_loud(tmp_path):
         Job(job="a", argv=["x"], ranks=0)
     with pytest.raises(ValueError, match="path-safe"):
         Job(job="a/b", argv=["x"])
+    with pytest.raises(ValueError, match="path-safe"):
+        Job(job="..", argv=["x"])    # must not escape the jobs/ dir
     with pytest.raises(ValueError, match="duplicate"):
         _sched(tmp_path, [Job(job="a", argv=["x"]),
                           Job(job="a", argv=["y"])])
@@ -409,6 +411,84 @@ def test_unsatisfiable_after_file_gate_fails_instead_of_spinning(
     assert summary["jobs"] == {"producer": "failed", "gated": "failed"}
     fail = _sched_rows(tmp_path, job="gated", event="sched_fail")
     assert fail and "can no longer be satisfied" in fail[0]["why"]
+
+
+# ---- the serve job kind runs a REAL serving worker (PR 15) ---------------
+
+def test_serve_job_kind_runs_serve_lm_evictions_are_loss_free(tmp_path):
+    """The `serve` job kind finally launches a real workload: a
+    tools/serve_lm.py worker (snapshot promoted through the validity
+    path, continuous-batched decode, closed-loop driven).  The drill
+    exercises BOTH eviction directions on a 1-device mesh:
+
+    1. serve (priority 0) arrives mid-bench and evicts the bench job —
+       the PR 14 SLO-preemption path, now with a real serving workload
+       behind it;
+    2. an urgent priority--1 job arrives mid-SERVE and evicts the
+       SERVING WORKER: TERM → drain in-flight requests to completion →
+       exit 143 (clean, rcs {"0": 143}) — the trainer's loss-free
+       preemption protocol with "state saved" read as "every admitted
+       request answered".  The relaunch re-issues exactly the
+       unfinished request ids from the results tape, so the final tape
+       holds every id exactly once: zero lost requests, zero repeats.
+    """
+    py = sys.executable
+    prog = str(tmp_path / "progress")
+    res = str(tmp_path / "serve_results.jsonl")
+    stats = str(tmp_path / "serve_stats.json")
+    victim = _victim_script(tmp_path)
+    n_req = 12
+    serve_argv = [py, os.path.join(REPO, "tools", "serve_lm.py"),
+                  "--snapshot", str(tmp_path / "snaps"),
+                  "--size", "lm_tiny", "--init_if_missing",
+                  "--slots", "2", "--max_len", "32",
+                  "--drive", str(n_req), "--clients", "2",
+                  "--drive_max_new", "4", "--drive_think_ms", "600",
+                  "--results", res, "--stats", stats]
+    jobs = [
+        Job(job="bench1", argv=[py, victim], kind="bench",
+            env={"PROG": prog}),
+        # ready the moment bench1 proves mid-run progress; the 1-device
+        # mesh is busy, so admission must evict bench1.
+        Job(job="serve1", argv=serve_argv, kind="serve",
+            after_file=prog, retries=2, wall_timeout_s=300.0,
+            kill_grace_s=15.0),
+        # ready the moment serve1 completes its first request (the
+        # results tape exists); outranks even `serve`, so admission
+        # must evict the SERVING worker — the teardown under test.
+        Job(job="urgent1", argv=[py, "-c", "pass"], kind="train",
+            priority=-1, after_file=res),
+    ]
+    summary = _sched(tmp_path, jobs, devices=1).run()
+    assert summary["jobs"] == {"bench1": "done", "serve1": "done",
+                               "urgent1": "done"}
+    # bench evicted for serve, serve evicted for urgent — both clean
+    evict_b = _sched_rows(tmp_path, job="bench1", event="sched_evict")
+    assert evict_b and evict_b[0]["for_job"] == "serve1"
+    evict_s = _sched_rows(tmp_path, job="serve1", event="sched_evict")
+    assert len(evict_s) == 1 and evict_s[0]["for_job"] == "urgent1"
+    assert evict_s[0]["clean"] is True
+    assert evict_s[0]["rcs"] == {"0": 143}      # TERM -> drain -> 143
+    # the serving worker resumed: two placements, the second resuming
+    places = _sched_rows(tmp_path, job="serve1", event="sched_place")
+    assert [p["attempt"] for p in places] == [1, 2]
+    assert places[1]["resumed"] is True
+    # loss-free: every driven request id exactly once across both
+    # placements — drained in-flight requests completed (never lost),
+    # completed ids never re-issued (never repeated)
+    ids = sorted(json.loads(line)["id"] for line in open(res))
+    assert ids == list(range(n_req))
+    # the bench victim's own tape stayed exact through ITS eviction
+    assert open(prog).read().split() == [f"i{i}" for i in range(10)]
+    # and the worker's runs are ledgered: run_start/run_end rows from
+    # serve_lm itself (the fleet exports OBS_LEDGER to its ranks)
+    serve_runs = [r for r in _ledger_rows(tmp_path)
+                  if r.get("event") == "run_start"
+                  and r.get("entrypoint") == "serve_lm"]
+    assert len(serve_runs) == 2                 # one per placement
+    final_stats = json.load(open(stats))
+    assert final_stats["preempted"] is False    # the resume finished
+    assert final_stats["size"] == "lm_tiny"
 
 
 # ---- the host_loss fault + fleet seam ------------------------------------

@@ -1,0 +1,421 @@
+"""serving/ — the continuous-batching inference engine (PR 15): decode
+parity with the training forward, mid-decode admission (continuous
+batching, not batch-drain), SLO admission, OOV refusal, snapshot →
+serving promotion edges (torn-newest fallback, row-layout
+materialization), the decode-step HLO contract, and the obs/ import
+direction.
+
+Inline and tier-1-safe: lm_tiny at tiny slot/cache geometry,
+single-device programs only (no collectives — none of the rendezvous
+risk the isolated files carry).  The engine fixture is module-scoped so
+its prefill/decode compiles are paid once.  The end-to-end serve_lm
+drill (real subprocess, eviction, TERM→143) lives in
+tests/test_scheduler.py next to the other control-plane drills.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedtensorflowexample_tpu.models import build_model
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+from distributedtensorflowexample_tpu.resilience.snapshot import (
+    SnapshotStore)
+from distributedtensorflowexample_tpu.serving.engine import (
+    DECODE_HLO_CONTRACT, DecodeEngine, serve_slots_default)
+from distributedtensorflowexample_tpu.serving.loadgen import (
+    DriveFile, make_prompt)
+from distributedtensorflowexample_tpu.serving.promote import (
+    init_lm_snapshot, promote)
+from distributedtensorflowexample_tpu.serving.queue import (
+    ContinuousBatcher, RequestQueue, percentile, serve_slo_ms_default)
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIZE = "lm_tiny"
+CACHE = 32
+
+
+def _tx():
+    return optax.sgd(0.1, momentum=0.9)
+
+
+@pytest.fixture(scope="module")
+def lm_state():
+    model = build_model(SIZE)
+    return model, TrainState.create(model, _tx(),
+                                    jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def engine(lm_state):
+    model, state = lm_state
+    return DecodeEngine(model, state.params, slots=3, cache_len=CACHE)
+
+
+def _greedy_reference(model, params, prompt, n):
+    """Teacher-forced greedy through the TRAINING forward — the truth
+    the engine must reproduce token-for-token."""
+    seq = list(int(t) for t in prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply({"params": params},
+                             jnp.asarray([seq], jnp.int32), train=False)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        seq.append(nxt)
+    return out
+
+
+def _engine_greedy(engine, slot, prompt, n):
+    toks = [engine.prefill(slot, np.asarray(prompt, np.int32),
+                           max_new=n)]
+    while len(toks) < n:
+        step = engine.decode()
+        toks.append(int(step[slot]))
+    return toks
+
+
+# ---- decode parity -------------------------------------------------------
+
+def test_decode_matches_training_forward_token_exact(lm_state, engine):
+    """The KV-cache decode (prefill + single-query steps) generates
+    token-for-token what teacher-forced greedy through the training
+    model generates: the cache path is the same math, masked rows
+    contribute exactly 0.0 after the f32 exp."""
+    model, state = lm_state
+    prompt = [5, 9, 17, 3, 88, 120, 7]
+    want = _greedy_reference(model, state.params, prompt, 6)
+    got = _engine_greedy(engine, 0, prompt, 6)
+    assert got == want
+    # A second prompt through a DIFFERENT slot, same engine, same truth
+    # (slot reuse after retirement is the continuous-batching steady
+    # state).
+    prompt2 = [200, 1, 42]
+    want2 = _greedy_reference(model, state.params, prompt2, 5)
+    assert _engine_greedy(engine, 2, prompt2, 5) == want2
+
+
+def test_prefill_bucket_table_and_refusals(engine):
+    assert engine.bucket_for(3, 4) == 8          # smallest bucket
+    assert engine.bucket_for(9, 4) == 16         # next power of two
+    assert engine.bucket_for(CACHE - 4, 4) == CACHE
+    with pytest.raises(ModeRefusal, match="--max_len"):
+        engine.bucket_for(CACHE - 2, 4)          # can never finish
+    with pytest.raises(ModeRefusal, match="--max_len"):
+        # a cache longer than the positional table is refused at build
+        DecodeEngine(engine.model, engine.params, slots=1,
+                     cache_len=engine.model.max_len + 1)
+
+
+# ---- continuous batching -------------------------------------------------
+
+def test_request_admitted_mid_decode_completes_bitwise(lm_state, engine):
+    """THE continuous-batching acceptance: B is admitted while A is
+    mid-decode (A visibly unfinished at B's admission) and B's output
+    equals B decoded solo — admission into an open slot of a RUNNING
+    batch, with zero cross-request contamination."""
+    model, state = lm_state
+    prompt_a = [10, 20, 30, 40, 50]
+    prompt_b = [7, 7, 99]
+    solo_b = _engine_greedy(engine, 1, prompt_b, 5)
+
+    queue = RequestQueue(engine.vocab)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=0.0)
+    ra = queue.submit(prompt_a, 12, rid="A")
+    batcher.step()                   # admits A, first decode
+    batcher.step()
+    assert not ra.done.is_set()      # A is mid-decode
+    rb = queue.submit(prompt_b, 5, rid="B")
+    batcher.step()                   # B admitted into an open slot NOW
+    assert rb.admit_t is not None and not ra.done.is_set(), \
+        "B must join while A is still decoding — batch-drain detected"
+    while not (ra.done.is_set() and rb.done.is_set()):
+        assert batcher.step() > 0
+    assert ra.outcome == "ok" and rb.outcome == "ok"
+    assert rb.tokens == solo_b       # bitwise: no contamination from A
+    assert ra.tokens[:6] == _greedy_reference(model, state.params,
+                                              prompt_a, 6)
+    assert len(ra.tokens) == 12 and ra.first_token_t <= rb.admit_t
+
+
+def test_slo_admission_rejects_predicted_misses(engine):
+    """A request the step-time EWMA predicts past the SLO is rejected
+    loudly at admission — never admitted to miss."""
+    queue = RequestQueue(engine.vocab)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=50.0)
+    batcher._step_ewma_s = 0.050     # 50 ms/step: 8 tokens >> 50 ms SLO
+    req = queue.submit([1, 2, 3], 8)
+    batcher.step()
+    assert req.done.is_set() and req.outcome == "slo_rejected"
+    # SLO off admits the same request
+    batcher2 = ContinuousBatcher(engine, queue, slo_ms=0.0)
+    batcher2._step_ewma_s = 0.050
+    req2 = queue.submit([1, 2, 3], 2)
+    batcher2.step()
+    assert req2.outcome in ("", "ok") and req2.admit_t is not None
+    while not req2.done.is_set():
+        batcher2.step()
+    assert req2.outcome == "ok"
+
+
+def test_drain_answers_inflight_and_rejects_queued(engine):
+    """The TERM half: drain decodes in-flight requests to completion
+    and rejects the queued tail as ``drained`` — nothing admitted is
+    lost, nothing queued hangs forever."""
+    queue = RequestQueue(engine.vocab)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=0.0)
+    inflight = [queue.submit([3, 1, 4], 6, rid=f"f{i}")
+                for i in range(3)]                  # fills all 3 slots
+    batcher.step()
+    queued = queue.submit([9, 9], 4, rid="tail")    # no slot for it
+    batcher.drain()
+    assert all(r.done.is_set() and r.outcome == "ok" and
+               len(r.tokens) == 6 for r in inflight)
+    assert queued.outcome == "drained" and queued.tokens == []
+    assert batcher.stats()["rejected"]["drained"] == 1
+    # The submit/drain race is closed at the queue: a submit landing
+    # AFTER drain is answered 'drained' synchronously — no caller is
+    # ever left blocked on a request nothing will decode.
+    late = queue.submit([1, 2], 3, rid="late")
+    assert late.done.is_set() and late.outcome == "drained"
+    assert len(queue) == 0
+    # Retired slots are PARKED: decode advances only busy frontiers,
+    # so an idle slot cannot drift toward the cache edge.
+    assert engine.positions.tolist() == [0] * engine.slots
+
+
+def test_oversized_request_refused_not_fatal(engine):
+    """A request that can never finish inside the cache is refused by
+    name AT ADMISSION — one impossible request costs itself, never the
+    serving loop (the batcher thread has no handler above it)."""
+    queue = RequestQueue(engine.vocab)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=0.0)
+    bad = queue.submit(list(range(CACHE - 2)), 8)    # 30 + 8 > 32
+    ok = queue.submit([1, 2, 3], 3)
+    batcher.step()
+    assert bad.done.is_set() and bad.outcome == "refused"
+    assert "--max_len" in bad.error
+    while not ok.done.is_set():
+        batcher.step()                               # loop survived
+    assert ok.outcome == "ok" and len(ok.tokens) == 3
+    assert batcher.stats()["rejected"]["refused"] == 1
+
+
+def test_ratchet_latency_metrics_gate_in_the_right_direction(tmp_path):
+    """``*_ms`` metrics are lower-is-better: the ratchet must flag a
+    latency INCREASE and stay quiet on an improvement — the inverse of
+    every throughput family."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_ratchet
+    finally:
+        _sys.path.pop(0)
+
+    def rec(value, rnd):
+        return {"metric": "serve_x_p99_ms", "value": value,
+                "detail": {"platform": "cpu", "spread_frac": 0.0},
+                "_file": f"SERVE_x_cpu_r{rnd:02d}.json", "_round": rnd}
+
+    worse = bench_ratchet.compare_records(
+        [rec(10.0, 1), rec(16.0, 2)], tolerance=0.10, noise=0.25)
+    assert len(worse) == 1 and worse[0]["severity"] == "regression"
+    assert worse[0]["drop_frac"] == pytest.approx(0.6)
+    better = bench_ratchet.compare_records(
+        [rec(10.0, 1), rec(7.0, 2)], tolerance=0.10, noise=0.25)
+    assert better == []
+
+
+def test_oov_request_refused_by_name(engine):
+    queue = RequestQueue(engine.vocab)
+    with pytest.raises(ModeRefusal, match="out-of-vocab"):
+        queue.submit([5, engine.vocab + 7], 4)
+    with pytest.raises(ValueError, match="non-empty"):
+        queue.submit([], 4)
+    with pytest.raises(ValueError, match="integers"):
+        queue.submit([1.5, 2.5], 4)
+    assert len(queue) == 0           # nothing leaked into the queue
+
+
+# ---- snapshot -> serving promotion edges ---------------------------------
+
+def test_promotion_falls_back_past_torn_newest(tmp_path, lm_state):
+    """A torn newest snapshot must cost one interval of freshness,
+    never the worker: promotion discards it (validity machinery) and
+    serves the previous valid step."""
+    model, state = lm_state
+    d = str(tmp_path / "snaps")
+    init_lm_snapshot(d, SIZE, seed=0)
+    store = SnapshotStore(d)
+    newer = state.replace(step=jnp.asarray(7, jnp.int32))
+    store.save(newer, meta={"model": SIZE, "update_layout": "tree"})
+    assert promote(d, SIZE).step == 7
+    store.tear_latest()
+    pm = promote(d, SIZE)
+    assert pm.step == 0              # fell back, did not die
+    # nothing valid left: promotion refuses loudly with a what-to-do
+    for s in store.steps():
+        os.remove(store._payload_path(s))
+    with pytest.raises(ValueError, match="no valid snapshot"):
+        promote(d, SIZE)
+
+
+def test_promotion_refuses_cross_model_by_name(tmp_path):
+    d = str(tmp_path / "snaps")
+    init_lm_snapshot(d, SIZE, seed=0)
+    with pytest.raises(ModeRefusal, match="--size"):
+        promote(d, "lm_small")
+
+
+def test_promotion_materializes_zero3_and_bucket_rows(tmp_path,
+                                                      lm_state):
+    """Row-layout snapshots (ZeRO-3 zero3_rows: params as 1/D bucket
+    rows; ZeRO-1 bucket_rows: optimizer state as rows) promote to the
+    BITWISE full param tree through the PR 12 materialize seam."""
+    import jax
+
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        init_bucketed_opt_state)
+    from distributedtensorflowexample_tpu.parallel.zero3 import (
+        Zero3Layout)
+    model, state = lm_state
+    mesh = make_mesh(2)
+    bucket_bytes = 16 << 10
+    full = jax.tree.map(np.asarray, state.params)     # host truth copy
+    repl = jax.device_put(state.params, replicated_sharding(mesh))
+
+    # zero3_rows: params AND opt state as rows
+    d3 = str(tmp_path / "z3")
+    meta3 = {"model": SIZE, "update_layout": "zero3_rows",
+             "mesh_size": 2, "bucket_bytes": bucket_bytes}
+    layout = Zero3Layout(repl, bucket_bytes, mesh)
+    opt = init_bucketed_opt_state(_tx(), repl, bucket_bytes, mesh)
+    rows_state = state.replace(opt_state=opt,
+                               params=layout.init_rows(repl))
+    SnapshotStore(d3).save(rows_state, meta=meta3)
+    pm = promote(d3, SIZE)
+    assert pm.layout == "zero3_rows"
+    got = jax.tree.map(np.asarray, pm.params)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(got)):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+
+    # bucket_rows: tree params, row opt state
+    d1 = str(tmp_path / "z1")
+    meta1 = {"model": SIZE, "update_layout": "bucket_rows",
+             "mesh_size": 2, "bucket_bytes": bucket_bytes}
+    z1_state = state.replace(opt_state=init_bucketed_opt_state(
+        _tx(), state.params, bucket_bytes, mesh))
+    SnapshotStore(d1).save(z1_state, meta=meta1)
+    pm1 = promote(d1, SIZE)
+    assert pm1.layout == "bucket_rows"
+    for a, b in zip(jax.tree.leaves(full),
+                    jax.tree.leaves(jax.tree.map(np.asarray,
+                                                 pm1.params))):
+        assert np.array_equal(a, b)
+
+    # a rows manifest without its geometry meta is refused loudly
+    d_bad = str(tmp_path / "bad")
+    SnapshotStore(d_bad).save(rows_state, meta={
+        "model": SIZE, "update_layout": "zero3_rows"})
+    with pytest.raises(ValueError, match="mesh_size"):
+        promote(d_bad, SIZE)
+
+
+# ---- the decode-step HLO contract ----------------------------------------
+
+def test_decode_hlo_contract_holds_and_catches_violations(engine):
+    """The compiled decode step honors DECODE_HLO_CONTRACT (donation
+    aliased, no donated-buffer copy, zero collectives, f32 ceiling) —
+    and the contract actually has teeth against a donation-less
+    compile of the same program."""
+    import jax
+
+    from distributedtensorflowexample_tpu.analysis.hlo_lint import (
+        check_contract)
+    hlo = engine.decode_hlo()
+    assert check_contract(hlo, DECODE_HLO_CONTRACT) == []
+    # Teeth: the SAME step compiled WITHOUT donation must fail the
+    # aliasing clause — the contract distinguishes the schedules.
+    undonated = jax.jit(engine._decode_fn).lower(
+        engine.params, engine._ck, engine._cv, engine.last_tokens,
+        engine.positions).compile().as_text()
+    findings = check_contract(undonated, DECODE_HLO_CONTRACT)
+    assert any(f.rule == "hlo-donation" for f in findings)
+
+
+def test_serving_suite_is_wired_into_the_hlo_front():
+    """graftlint's HLO front includes the serving decode contract, so
+    `python -m tools.graftlint` gates it like the ZeRO schedules."""
+    from distributedtensorflowexample_tpu.analysis import hlo_lint
+    progs = hlo_lint.serving_suite()
+    assert [p["mode"] for p in progs] == ["serve_decode"]
+    assert progs[0]["contract"] is DECODE_HLO_CONTRACT
+    fs = hlo_lint.check_contract(progs[0]["hlo"], progs[0]["contract"])
+    assert fs == [], [f.message for f in fs]
+
+
+# ---- knobs, helpers, import direction ------------------------------------
+
+def test_env_knob_defaults(monkeypatch):
+    monkeypatch.delenv("SERVE_SLOTS", raising=False)
+    monkeypatch.delenv("SERVE_SLO_MS", raising=False)
+    assert serve_slots_default() == 4
+    assert serve_slo_ms_default() == 0.0
+    monkeypatch.setenv("SERVE_SLOTS", "7")
+    monkeypatch.setenv("SERVE_SLO_MS", "125.5")
+    assert serve_slots_default() == 7
+    assert serve_slo_ms_default() == 125.5
+    monkeypatch.setenv("SERVE_SLOTS", "bogus")
+    assert serve_slots_default() == 4
+
+
+def test_percentiles_and_drive_file(tmp_path):
+    assert percentile([], 0.5) == 0.0
+    tape = sorted([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert percentile(tape, 0.5) == 3.0
+    assert percentile(tape, 0.99) == 100.0
+    df = DriveFile(str(tmp_path / "res.jsonl"))
+    assert df.done_ids() == {}
+    df.append(3, [1, 2])
+    df.append(0, [9])
+    with open(df.path, "a") as f:
+        f.write('{"id": 7, "tok')          # torn tail: id 7 re-issues
+    assert df.done_ids() == {3: [1, 2], 0: [9]}
+    # deterministic prompts: same id -> same bytes, ids differ
+    a = make_prompt(17, 250, seed=3)
+    assert np.array_equal(a, make_prompt(17, 250, seed=3))
+    assert not np.array_equal(a, make_prompt(18, 250, seed=3)) \
+        or len(a) != len(make_prompt(18, 250, seed=3))
+
+
+def test_obs_never_imports_serving():
+    """The import direction is one-way: serving/ may use obs/ (metrics,
+    ledger), obs/ must stay stdlib-only and serving-free — the
+    graftlint import-graph proof guards the jax half; this guards the
+    package-internal half."""
+    import ast
+    obs_dir = os.path.join(REPO, "distributedtensorflowexample_tpu",
+                           "obs")
+    for name in sorted(os.listdir(obs_dir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(obs_dir, name)) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            assert not any(".serving" in m or m == "serving"
+                           for m in mods), \
+                f"obs/{name} imports serving ({mods})"

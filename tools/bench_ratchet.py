@@ -135,12 +135,22 @@ def outage_rounds(records_dir: str) -> set:
             glob.glob(os.path.join(records_dir, "OUTAGE_r*.md"))} - {-1}
 
 
+def _lower_is_better(metric: str) -> bool:
+    """Latency-family metrics (the serving p50/p99 ``*_ms`` lines)
+    regress UPWARD — the throughput rule inverted, or a 26% latency
+    improvement would gate as an 'unexplained drop' while a real
+    regression sailed through."""
+    return metric.endswith("_ms")
+
+
 def compare_records(records: list[dict], tolerance: float,
                     noise: float, outages: set = frozenset()) -> list[dict]:
-    """Per (metric, platform): newest record vs the best prior.  Returns
+    """Per (metric, platform): newest record vs the best prior (best =
+    highest value, or LOWEST for ``*_ms`` latency metrics).  Returns
     finding dicts with ``severity`` 'regression' (unexplained) or
     'explained' (window variance / noisy measurement) — see module
-    docstring for the rule."""
+    docstring for the rule.  ``drop_frac`` is always the worsening
+    magnitude, whichever direction that metric worsens in."""
     series: dict = {}
     for rec in records:
         series.setdefault((rec["metric"], _platform(rec)), []).append(rec)
@@ -149,11 +159,21 @@ def compare_records(records: list[dict], tolerance: float,
         if len(recs) < 2:
             continue
         newest = recs[-1]
-        prior = max(recs[:-1], key=lambda r: r.get("value") or 0.0)
-        new_v, old_v = newest.get("value") or 0.0, prior.get("value") or 0.0
-        if old_v <= 0 or new_v >= (1.0 - tolerance) * old_v:
-            continue
-        drop = 1.0 - new_v / old_v
+        if _lower_is_better(metric):
+            prior = min(recs[:-1],
+                        key=lambda r: r.get("value") or float("inf"))
+            new_v = newest.get("value") or 0.0
+            old_v = prior.get("value") or 0.0
+            if old_v <= 0 or new_v <= (1.0 + tolerance) * old_v:
+                continue
+            drop = new_v / old_v - 1.0
+        else:
+            prior = max(recs[:-1], key=lambda r: r.get("value") or 0.0)
+            new_v = newest.get("value") or 0.0
+            old_v = prior.get("value") or 0.0
+            if old_v <= 0 or new_v >= (1.0 - tolerance) * old_v:
+                continue
+            drop = 1.0 - new_v / old_v
         base = {"metric": metric, "platform": platform,
                 "newest": new_v, "newest_file": newest["_file"],
                 "prior": old_v, "prior_file": prior["_file"],
@@ -295,10 +315,12 @@ def build_trajectory(records_dir: str) -> list[dict]:
     checked-in artifact diffs like code."""
     rows: list[dict] = []
     # SCHED_* is the scheduler's queue-completion record family
-    # (tools/schedule.py --record): the same metric-row dialect as the
-    # bench families, so the control plane's throughput rides the same
-    # trajectory/ratchet surface as every other measured thing.
-    for pattern in ("BENCH_*.json", "SCHED_*.json"):
+    # (tools/schedule.py --record) and SERVE_* the serving bench family
+    # (bench_serving.py throughput-vs-SLO curves): the same metric-row
+    # dialect as the bench families, so the control plane's and the
+    # serving path's throughput ride the same trajectory/ratchet
+    # surface as every other measured thing.
+    for pattern in ("BENCH_*.json", "SCHED_*.json", "SERVE_*.json"):
         for path in sorted(glob.glob(os.path.join(records_dir,
                                                   pattern))):
             if os.path.basename(path) == _TRAJECTORY_NAME:
@@ -397,7 +419,10 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--records_dir", default=_REPO,
                    help="where the BENCH_*.json records live")
-    p.add_argument("--glob", default="BENCH_*.json")
+    p.add_argument("--glob", default="BENCH_*.json,SERVE_*.json",
+                   help="comma-separated record patterns the prior-"
+                        "record ratchet scans (the serving family "
+                        "regresses like any bench family)")
     p.add_argument("--baseline", default="",
                    help="BASELINE_SELF.json (default: in records_dir)")
     p.add_argument("--tolerance", type=float, default=0.10,
@@ -427,8 +452,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="machine-readable verdict on stdout")
     args = p.parse_args(argv)
 
-    paths = sorted(p for p in glob.glob(os.path.join(args.records_dir,
-                                                     args.glob))
+    paths = sorted(p for pat in args.glob.split(",") if pat
+                   for p in glob.glob(os.path.join(args.records_dir,
+                                                   pat.strip()))
                    if os.path.basename(p) != _TRAJECTORY_NAME)
     records = load_records(paths)
     baseline_path = args.baseline or os.path.join(args.records_dir,
@@ -475,7 +501,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  [{f_['severity']}] {f_['metric']} ({f_['platform']}):"
                   f" {f_['prior']:g} ({f_['prior_file']}) -> "
                   f"{f_['newest']:g} ({f_['newest_file']}), "
-                  f"-{f_['drop_frac']:.1%} — {f_['why']}")
+                  f"worse by {f_['drop_frac']:.1%} — {f_['why']}")
         if not findings:
             print("  no drops beyond tolerance")
         for a in armed:

@@ -16,10 +16,12 @@ Source front (analysis/src_lint.py — stdlib-only, no jax import):
                   regions' current content
 
 HLO front (analysis/hlo_lint.py — compiles the per-mode softmax suite
-on a CPU mesh, then checks each module against the contract declared
-next to its step builder in parallel/{sync,bucketing,zero3}.py):
-zero3's AG-before-RS prefetch with no step-closing AG, zero1's RS+AG
-pair, per-mode collective budgets, donation aliasing, dtype ceilings.
+on a CPU mesh plus the serving decode step, then checks each module
+against the contract declared next to its step builder in
+parallel/{sync,bucketing,zero3}.py and serving/engine.py): zero3's
+AG-before-RS prefetch with no step-closing AG, zero1's RS+AG pair,
+per-mode collective budgets, donation aliasing (incl. the serving
+KV-cache's donate-and-reuse step), dtype ceilings.
 
 Findings flow through the checked-in waiver file
 (analysis/waivers.json — dated + reasoned, budget 5, stale waivers are

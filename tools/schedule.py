@@ -75,12 +75,18 @@ def demo_queue(workdir: str, steps: int = 12,
       requeued;
     - ``bench1`` — a slow bench job (persistent ``slow_rank`` delay =
       a real bench's pace) that a late-arriving
-    - ``serve1`` — full-mesh serving load test (priority 0, ready once
-      bench1 proves mid-run progress via its step-6 snapshot — late
-      enough that elastic2's shrink/grow cycle has already run) EVICTS:
+    - ``serve1`` — a REAL serving fleet (PR 15): 4 ranks of
+      ``tools/serve_lm.py``, each promoting a snapshot and driving its
+      closed loop (priority 0, ready once bench1 proves mid-run
+      progress via its step-6 snapshot — late enough that elastic2's
+      shrink/grow cycle has already run) EVICTS bench1:
       TERM→143→snapshot, then bench1 resumes with zero lost steps.
+      An evicted serving rank drains its in-flight requests before its
+      own 143 — the trainer protocol, re-read for serving.
     """
     py = sys.executable
+    serve_lm = os.path.join(_REPO, "tools", "serve_lm.py")
+    serve_dir = os.path.join(workdir, "jobs", "serve1", "rank{rank}")
 
     def fl(job, plan, job_steps=steps, ranks=1, **kw):
         base = {"job": job, "ranks": ranks,
@@ -115,11 +121,20 @@ def demo_queue(workdir: str, steps: int = 12,
         # eviction loss-free.
         fl("bench1", f"slow_rank@1:{slow_s}", steps, kind="bench"),
         # ready the moment bench1's step-6 snapshot commits (no
-        # wall-clock guessing): a full-mesh, priority-0 load test that
-        # cannot fit without evicting someone.
-        fl("serve1", "none", 4, ranks=4, kind="serve",
-           after_file=os.path.join(workdir, "jobs", "bench1", "rank0",
-                                   "snapshots", "snap_00000006.npz")),
+        # wall-clock guessing): a full-mesh, priority-0 REAL serving
+        # fleet that cannot fit without evicting someone.
+        {"job": "serve1", "kind": "serve", "ranks": 4,
+         "argv": [py, serve_lm,
+                  "--snapshot", os.path.join(serve_dir, "snaps"),
+                  "--size", "lm_tiny", "--init_if_missing",
+                  "--slots", "2", "--max_len", "32",
+                  "--drive", "24", "--clients", "2",
+                  "--drive_max_new", "6",
+                  "--results", os.path.join(serve_dir, "results.jsonl"),
+                  "--stats", os.path.join(serve_dir, "stats.json")],
+         "steps": 24, "est_step_time_s": 1.0,
+         "after_file": os.path.join(workdir, "jobs", "bench1", "rank0",
+                                    "snapshots", "snap_00000006.npz")},
     ]
 
 
