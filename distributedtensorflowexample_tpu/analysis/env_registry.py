@@ -35,6 +35,35 @@ ENV_REGISTRY: dict[str, str] = {
         "supervisor): the host_loss fault writes it and the next spawn "
         "of that rank fails like a dead host (resilience/faults.py, "
         "resilience/fleet.py)."),
+    "HEAL_ACTION_BUDGET": (
+        "Global remediation-actions ceiling per remediator JOURNAL "
+        "(WAL replay restores the spent count; a new journal resets "
+        "it); exhaustion degrades to detection-only with a loud "
+        "heal_budget_exhausted ledger row "
+        "(resilience/remediate.py; default 8)."),
+    "HEAL_CANARY_FRACTION": (
+        "Share of serving requests routed to a canary candidate while "
+        "it proves itself (serving/promote.py; default 0.25)."),
+    "HEAL_CANARY_P99_RATIO": (
+        "Canary p99 over this multiple of the baseline arm's p99 = "
+        "regression, auto-rollback (serving/promote.py; default 2.0)."),
+    "HEAL_CANARY_WINDOW": (
+        "Canary-arm completions required before a promote/rollback "
+        "verdict (serving/promote.py; default 16)."),
+    "HEAL_COOLDOWN_S": (
+        "Per-(kind, scope) quiet period after a remediation action — "
+        "the action-storm guard (resilience/remediate.py; default 30)."),
+    "HEAL_DRY_RUN": (
+        "1 = remediation commissioning mode: journal heal_dry_run rows "
+        "naming what WOULD fire, run no actuator "
+        "(resilience/remediate.py)."),
+    "HEAL_FLAP_N": (
+        "Detections of one (kind, scope) inside the flap window before "
+        "a remediation policy may act — a one-poll blip never reaches "
+        "an actuator (resilience/remediate.py; default 2)."),
+    "HEAL_FLAP_WINDOW_S": (
+        "The flap-damping window in seconds "
+        "(resilience/remediate.py; default 60)."),
     "OBS_ANOMALY_SKIP": (
         "Steps ignored at window start before the anomaly baseline "
         "arms (obs/anomaly.py; default 1 — the compile step)."),

@@ -21,6 +21,12 @@ Three cooperating pieces, each usable alone:
   multi-job queue admitted against measured cost, packed onto the
   device mesh, with elastic shrink/grow-on-recovery and loss-free
   SLO preemption as policy (tools/schedule.py).
+- :mod:`.remediate` — the self-healing layer: anomaly detections
+  (health.json flags, ledger rows, serve_* scrapes) mapped through
+  declared, rate-limited policies onto the actuators above —
+  guardrailed (flap damping, cooldowns, a global action budget,
+  dry-run), write-ahead journaled, every decision a ``heal_*`` ledger
+  row (tools/heal_drill.py measures MTTD/MTTR per fault class).
 
 Everything here runs on CPU — the outage this subsystem exists for can
 never block its own tests.
@@ -32,6 +38,11 @@ from distributedtensorflowexample_tpu.resilience.faults import (  # noqa: F401
 from distributedtensorflowexample_tpu.resilience.fleet import (  # noqa: F401
     FleetSupervisor, GangResult, RankLossRefused,
     RankLossStructurallyIllegal, RankLostError)
+from distributedtensorflowexample_tpu.resilience.remediate import (  # noqa: F401
+    HEAL_EVENTS, AnomalyEvent, FleetTarget, Guardrails, HealRule,
+    HealthWatcher, LedgerWatcher, Remediator, ServeWatcher,
+    make_evict_actuator, make_quarantine_actuator,
+    make_rollback_actuator, make_slo_actuator, run_remediated)
 from distributedtensorflowexample_tpu.resilience.scheduler import (  # noqa: F401
     Job, Scheduler, load_queue)
 from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401

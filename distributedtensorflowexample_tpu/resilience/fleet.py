@@ -209,6 +209,21 @@ def _classify(rc: int | None) -> str:
     return "crash"
 
 
+def resolve_ledger_dest(configured: str) -> str:
+    """The ONE run-ledger destination rule, shared by the fleet's own
+    rows, the env each rank inherits (spawn uses ``env.setdefault``),
+    and every layer that must watch the same file (the ``--heal``
+    remediator): an operator's box-wide ``OBS_LEDGER`` export wins over
+    ``configured``, and a PRESENT-but-empty export is "set to disabled"
+    (``setdefault`` skips a present key; ``maybe_begin`` treats "" as
+    no ledger) — never a fall-through to the default.  One drill must
+    land in ONE file; rows split across two files would show half the
+    story to either reader."""
+    if "OBS_LEDGER" in os.environ:
+        return os.environ["OBS_LEDGER"]
+    return configured
+
+
 class FleetSupervisor:
     """Launch and babysit an N-rank gang; see the module docstring for
     the state machine.  ``workdir`` holds per-rank heartbeat files and
@@ -304,26 +319,19 @@ class FleetSupervisor:
         # shrink path); the recovery re-probe re-adds them when their
         # host answers again — see probe_lost_ranks/reprobe_lost_ranks.
         self._lost: set[int] = set()
+        # Straggler/flag latches — reset per gang attempt in _run_gang,
+        # initialized here so the `stragglers` property (read by the
+        # scheduler's heal policy from its tick thread) is safe before
+        # the first attempt launches.
+        self._stragglers: set = set()
+        self._flagged: set = set()
         # One port per ORIGINAL rank, chosen once: a gang restart reuses
         # the same coordinator address, like a real re-scheduled job
         # whose hosts keep their endpoints.
         self._ports = [_free_port() for _ in range(num_ranks)]
 
     def _ledger_dest(self) -> str:
-        """Where THIS fleet's rows go — the SAME resolution the
-        children see (spawn uses ``env.setdefault``, so an operator's
-        box-wide ``OBS_LEDGER`` export wins there too): env first, then
-        the configured workdir default.  One drill must land in ONE
-        file; gang rows split from rank rows would show half the story
-        to either file's reader.  Empty = no fleet rows (and the
-        explicit path below keeps ``log_event``'s own env fallback from
-        resurrecting a disabled ledger).  A PRESENT-but-empty export is
-        "set to disabled", exactly as the children read it
-        (``setdefault`` skips a present key; ``maybe_begin`` treats ""
-        as no ledger) — never a fall-through to the default."""
-        if "OBS_LEDGER" in os.environ:
-            return os.environ["OBS_LEDGER"]
-        return self.ledger_path
+        return resolve_ledger_dest(self.ledger_path)
 
     def _ledger_event(self, event: str, **fields) -> None:
         dest = self._ledger_dest()
@@ -500,12 +508,24 @@ class FleetSupervisor:
         return True
 
     @property
+    def stragglers(self) -> list[int]:
+        """Ranks the CURRENT gang attempt's monitor pass has named
+        straggler (lag + slowness evidence, obs/anomaly.detect_skew) —
+        what the remediation policy layer (resilience/remediate.py,
+        the scheduler's heal pass) reads.  Cross-thread like
+        ``lost_ranks``: the writer publishes copy-on-write (rebind,
+        never in-place mutation), so this read iterates a set that can
+        no longer change size under it."""
+        return sorted(self._stragglers)
+
+    @property
     def lost_ranks(self) -> list[int]:
         """Original rank ids dropped by the elastic shrink path and not
         yet recovered — what the scheduler's grow policy watches.  Read
         from the scheduler's tick thread while the fleet's run thread
-        mutates the set, so take one C-level copy before iterating."""
-        return sorted(set(self._lost))
+        updates it — copy-on-write on the writer side, like
+        ``stragglers``."""
+        return sorted(self._lost)
 
     def probe_lost_ranks(self, argv: list[str]) -> list[int]:
         """Non-mutating recovery probe: which lost ranks could spawn
@@ -534,7 +554,7 @@ class FleetSupervisor:
         so a postmortem shows the shrink AND the grow."""
         recovered = self.probe_lost_ranks(argv)
         for r in recovered:
-            self._lost.discard(r)
+            self._lost = self._lost - {r}
             self.ranks.append(r)
             self.ranks.sort()
             _RANKS_RECOVERED.inc()
@@ -735,17 +755,32 @@ class FleetSupervisor:
                     self.journal.write(
                         "anomaly", task=name, attempt=attempt, rank=r,
                         kind=kind, fired_step=f.get("fired_step"))
+                    # Mirrored into the run ledger so the remediation
+                    # layer (and obs_query) can consume detections
+                    # without the fleet's private journal.
+                    self._ledger_event(
+                        "anomaly", task=name, attempt=attempt, rank=r,
+                        kind=kind, fired_step=f.get("fired_step"))
         skew = obs_anomaly.detect_skew(ranks,
                                        lag_steps=self.skew_lag_steps,
                                        time_ratio=self.skew_time_ratio)
         if skew["lag_steps"]:
             _SKEW.set(max(skew["lag_steps"].values()))
         new = [r for r in skew["stragglers"] if r not in self._stragglers]
+        if new:
+            # Copy-on-write publish: the scheduler's tick thread reads
+            # `stragglers` concurrently — an in-place .add() under its
+            # iteration raises "set changed size during iteration";
+            # rebinding an already-complete set is atomic.
+            self._stragglers = self._stragglers | set(new)
         for r in new:
-            self._stragglers.add(r)
             _STRAGGLERS.labels(rank=r).inc()
             obs_anomaly.FLAGS_TOTAL.labels(kind="straggler", rank=r).inc()
             self.journal.write(
+                "anomaly", task=name, attempt=attempt, rank=r,
+                kind="straggler", step=ranks[r].get("step"),
+                max_step=skew["max_step"], why=skew["why"].get(r))
+            self._ledger_event(
                 "anomaly", task=name, attempt=attempt, rank=r,
                 kind="straggler", step=ranks[r].get("step"),
                 max_step=skew["max_step"], why=skew["why"].get(r))
@@ -834,13 +869,19 @@ class FleetSupervisor:
                     self.journal.write("rank_lost", task=name,
                                        attempt=attempt, rank=rank,
                                        error=str(e))
+                    # Ledger mirror: host losses are remediation-layer
+                    # input (repeated-offender quarantine policy) and
+                    # must be consumable without the fleet journal.
+                    self._ledger_event("rank_lost", task=name,
+                                       attempt=attempt, rank=rank,
+                                       error=str(e))
                     if self.worker_tiled:
                         raise RankLossStructurallyIllegal(rank, attempt,
                                                           str(e)) from e
                     if not self.elastic:
                         raise RankLossRefused(rank, attempt, str(e)) from e
                     self.ranks.remove(rank)
-                    self._lost.add(rank)
+                    self._lost = self._lost | {rank}
                     if not self.ranks:
                         raise RankLossRefused(rank, attempt, str(e)) from e
                     _log(f"{name}: rank {rank} lost ({e}); elastic — "

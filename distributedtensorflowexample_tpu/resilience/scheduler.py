@@ -70,6 +70,8 @@ import time
 
 from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.resilience import (
+    remediate as heal_mod)
 from distributedtensorflowexample_tpu.resilience.fleet import (
     FleetSupervisor, GangResult, RankLostError)
 from distributedtensorflowexample_tpu.resilience.supervisor import (
@@ -315,7 +317,8 @@ class Scheduler:
                  cost_margin: float = 16.0,
                  max_job_s: float = 0.0,
                  trajectory_path: str = "",
-                 retry_policy: RetryPolicy | None = None):
+                 retry_policy: RetryPolicy | None = None,
+                 heal: bool = True):
         if devices < 1:
             raise ValueError(f"devices {devices} must be >= 1")
         self.devices = devices
@@ -349,6 +352,22 @@ class Scheduler:
             self._jobs[job.job] = _JobState(
                 job=job, priority=job.resolved_priority(self._slo),
                 submit_idx=i)
+        # ROADMAP direction 5's named rung: anomaly detections feed
+        # eviction policy — a straggling job yields its devices to
+        # queued healthy work (resilience/remediate.py; flap/cooldown/
+        # budget guardrails + HEAL_DRY_RUN apply, and the heal_* rows
+        # land in the same ledger the sched_* rows do).  The policy
+        # engine shares this scheduler's journal: its WAL replays with
+        # ours, and _replay ignores the heal_* rows it doesn't own.
+        # Constructed AFTER _jobs: construction replays unmatched
+        # heal_intents through _heal_evict, which reads _jobs (every
+        # job is still "queued" here, so the replay resolves to the
+        # documented idempotent noop, not an AttributeError row).
+        self._remediator = heal_mod.Remediator(
+            journal=self.journal, ledger_path=self.ledger_path or "",
+            actuators={"evict": self._heal_evict},
+            policy={"straggler": heal_mod.HealRule("evict")},
+        ) if heal else None
 
     # --- journal + ledger plumbing ----------------------------------------
     def _wal(self, event: str, **fields) -> None:
@@ -843,6 +862,74 @@ class Scheduler:
             st.stop = ("grow", seq, recovered)
             fleet.request_stop("grow")
 
+    def _drive_heal(self) -> None:
+        """Anomaly-driven eviction policy: each tick, a running job
+        whose monitor pass has NAMED a straggler (lag + slowness
+        evidence, never lag alone — obs/anomaly.detect_skew's bar)
+        feeds the remediation engine; after the flap/cooldown
+        guardrails clear, the job is evicted loss-free (TERM→143→
+        snapshot→requeue) so its devices go to queued healthy work and
+        its own relaunch sheds the transient slowdown.  Detection-only
+        when nothing is queued — evicting a straggler with no
+        beneficiary buys nothing but churn (the actuator answers
+        ``noop`` and no budget is spent)."""
+        if self._remediator is None:
+            return
+        waiting = [s for s in self._jobs.values() if s.state == "queued"]
+        for st in self._running():
+            fleet = st.fleet
+            if fleet is None or st.stop is not None:
+                continue
+            for r in fleet.stragglers:
+                # Keyed per PLACEMENT (launches): a second straggler
+                # episode of the same (job, rank) after an eviction +
+                # relaunch is a fresh anomaly and gets its own
+                # heal_detect row; within one placement, re-observed
+                # polls dedup as one detection.  The guardrail key
+                # (kind, job) is launch-free, so cooldown still spans
+                # relaunches — no evict storm.
+                self._remediator.observe(heal_mod.AnomalyEvent(
+                    kind="straggler",
+                    key=f"{st.job.job}:l{st.launches}:straggler:rank{r}",
+                    scope=st.job.job, rank=r, source="fleet",
+                    detail={"waiting": [w.job.job for w in waiting]}))
+
+    def _heal_evict(self, ev: heal_mod.AnomalyEvent) -> dict:
+        """The straggler-eviction actuator: routed through the normal
+        sched WAL (intent → request_stop → the reap's sched_evict row),
+        so the eviction story reads identically to an SLO preemption —
+        plus the heal_* rows naming the anomaly that caused it."""
+        st = self._jobs.get(ev.scope or "")
+        if st is None or st.state != "running" or st.fleet is None \
+                or st.stop is not None:
+            return {"noop": "job not running (or a stop is already "
+                            "pending)"}
+        waiting = sorted(
+            (s for s in self._jobs.values() if s.state == "queued"),
+            key=lambda s: (s.priority, s.submit_idx))
+        if not waiting:
+            return {"noop": "no queued job waiting for capacity"}
+        # The eviction must have a beneficiary that can actually PLACE
+        # in what it frees (plus what is already free) — evicting a
+        # straggler for a head job still too wide to fit is pure
+        # evict-relaunch churn, burning the action budget and the
+        # victim's wall time with zero queued work served.
+        fits = self._free() + st.width
+        head = next((w for w in waiting if w.job.ranks <= fits), None)
+        if head is None:
+            return {"noop": f"no queued job fits the {fits} device(s) "
+                            f"this eviction would make available"}
+        stragglers = st.fleet.stragglers
+        why = (f"rank(s) {stragglers} named straggler by the anomaly "
+               f"monitor — yielding {st.width} device(s) to queued job "
+               f"`{head.job.job}` (anomaly-driven heal policy)")
+        seq = self._intent("evict", st.job.job, for_job=head.job.job,
+                           heal=True)
+        st.stop = ("evicted", seq, (head.job.job, why))
+        st.fleet.request_stop("heal_evict")
+        _log(f"{st.job.job}: requesting clean stop — {why}")
+        return {"for_job": head.job.job, "stragglers": stragglers}
+
     def _evict_for(self, head: _JobState, free: int) -> bool:
         """SLO preemption: free enough devices for ``head`` by cleanly
         stopping strictly-less-urgent running jobs — least urgent
@@ -876,6 +963,7 @@ class Scheduler:
         self._reap()
         self._observe_running()
         self._drive_grow()
+        self._drive_heal()
         now = time.monotonic()
         free = self._free()
         _DEVICES_BUSY.set(self.devices - free)

@@ -181,6 +181,161 @@ def init_lm_snapshot(snapshot_dir: str, size: str, seed: int = 0,
     return int(state.step)
 
 
+# --- canary promotion (the self-healing rung, resilience/remediate.py) -----
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def canary_fraction_default() -> float:
+    """``HEAL_CANARY_FRACTION``: share of requests routed to a canary
+    candidate while it proves itself (default 0.25)."""
+    return _env_float("HEAL_CANARY_FRACTION", 0.25)
+
+
+def canary_window_default() -> int:
+    """``HEAL_CANARY_WINDOW``: canary-arm completions required before a
+    promote/rollback verdict (default 16)."""
+    return int(_env_float("HEAL_CANARY_WINDOW", 16))
+
+
+def canary_p99_ratio_default() -> float:
+    """``HEAL_CANARY_P99_RATIO``: canary p99 over this multiple of the
+    baseline arm's p99 inside the window = regression → rollback
+    (default 2.0)."""
+    return _env_float("HEAL_CANARY_P99_RATIO", 2.0)
+
+
+def params_healthy(params) -> bool:
+    """Every float leaf finite — the pre-exposure canary probe: a
+    NaN-poisoned snapshot (the OOV-poison shape, a torn quantizer, a
+    diverged run an operator promoted by mistake) is caught BEFORE a
+    single request routes to it.  Cheap relative to one prefill."""
+    import jax
+    for leaf in jax.tree.leaves(params):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) \
+                and not np.all(np.isfinite(arr)):
+            return False
+    return True
+
+
+class Canary:
+    """Canary promotion state machine: a candidate snapshot serves a
+    deterministic ``fraction`` of requests first, and the promotion
+    commits only after a clean observation window — auto-rollback on a
+    NaN probe or a p99 regression vs the baseline arm.
+
+    State: ``probing`` → (``rolled_back`` | ``serving``) →
+    (``promoted`` | ``rolled_back``).  This object owns the DECISION
+    only; the serving harness owns the two engine arms and the drain
+    (an in-flight canary request always decodes to completion —
+    rollback must never drop admitted work, exactly the eviction
+    protocol's rule).  Verdicts land as ``heal_canary_promote`` /
+    ``heal_canary_rollback`` ledger rows via the remediation engine."""
+
+    def __init__(self, baseline_step: int, candidate_step: int, *,
+                 fraction: float | None = None,
+                 window: int | None = None,
+                 p99_ratio: float | None = None):
+        self.baseline_step = int(baseline_step)
+        self.candidate_step = int(candidate_step)
+        self.fraction = canary_fraction_default() if fraction is None \
+            else float(fraction)
+        self.window = canary_window_default() if window is None \
+            else int(window)
+        self.p99_ratio = canary_p99_ratio_default() if p99_ratio is None \
+            else float(p99_ratio)
+        self.state = "probing"
+        self.reason = ""
+        self._lat: dict[str, list] = {"canary": [], "baseline": []}
+        self._bad: int = 0
+
+    def admit_candidate(self, candidate_params) -> bool:
+        """The pre-exposure probe; False = immediate rollback (the
+        candidate never serves)."""
+        if not params_healthy(candidate_params):
+            self.state = "rolled_back"
+            self.reason = ("candidate params carry non-finite values — "
+                           "rolled back before serving a single request")
+            return False
+        self.state = "serving"
+        return True
+
+    def route(self, rid: str) -> str:
+        """Deterministic request routing while ``serving``: the same
+        rid always lands on the same arm (a retried request must not
+        flap arms mid-experiment)."""
+        if self.state != "serving":
+            return "baseline"
+        import zlib
+        bucket = zlib.crc32(str(rid).encode()) % 10_000
+        return "canary" if bucket < self.fraction * 10_000 else "baseline"
+
+    def observe(self, arm: str, latency_s: float, ok: bool = True) -> None:
+        if not ok and arm == "canary":
+            self._bad += 1
+        self._lat.setdefault(arm, []).append(float(latency_s))
+
+    @staticmethod
+    def _p99(tape: list) -> float | None:
+        if not tape:
+            return None
+        from distributedtensorflowexample_tpu.serving.queue import (
+            percentile)
+        return percentile(sorted(tape), 0.99)
+
+    def verdict(self) -> str | None:
+        """None while the window is still filling; else ``promote`` /
+        ``rollback`` (state committed, latched)."""
+        if self.state in ("promoted", "rolled_back"):
+            return ("promote" if self.state == "promoted"
+                    else "rollback")
+        if self._bad:
+            self.state = "rolled_back"
+            self.reason = (f"{self._bad} canary request(s) failed "
+                           f"(NaN/garbage outcome) inside the window")
+            return "rollback"
+        can = self._lat["canary"]
+        if len(can) < self.window:
+            return None
+        p99c = self._p99(can)
+        p99b = self._p99(self._lat["baseline"])
+        if p99b and p99c is not None and p99c > self.p99_ratio * p99b:
+            self.state = "rolled_back"
+            self.reason = (f"canary p99 {p99c * 1000:.1f}ms > "
+                           f"{self.p99_ratio:g}x baseline p99 "
+                           f"{p99b * 1000:.1f}ms over {len(can)} "
+                           f"canary completions")
+            return "rollback"
+        self.state = "promoted"
+        self.reason = (f"clean window: {len(can)} canary completions, "
+                       f"p99 {0 if p99c is None else p99c * 1000:.1f}ms"
+                       + (f" vs baseline {p99b * 1000:.1f}ms" if p99b
+                          else ""))
+        return "promote"
+
+    def payload(self) -> dict:
+        p99c, p99b = self._p99(self._lat["canary"]), \
+            self._p99(self._lat["baseline"])
+        return {
+            "state": self.state, "reason": self.reason,
+            "baseline_step": self.baseline_step,
+            "candidate_step": self.candidate_step,
+            "fraction": self.fraction, "window": self.window,
+            "p99_ratio": self.p99_ratio,
+            "canary_n": len(self._lat["canary"]),
+            "baseline_n": len(self._lat["baseline"]),
+            "canary_p99_ms": (None if p99c is None
+                              else round(p99c * 1000, 3)),
+            "baseline_p99_ms": (None if p99b is None
+                                else round(p99b * 1000, 3)),
+            "canary_failures": self._bad}
+
+
 def as_prompt(tokens, vocab: int) -> np.ndarray:
     """Validate a request's prompt tokens on the HOST, before anything
     reaches the device: out-of-vocab ids are refused by name — the

@@ -78,6 +78,19 @@ def serve_slo_ms_default() -> float:
         return 0.0
 
 
+def recent_p99_ms(completed: list, window: int = 32) -> float | None:
+    """p99 (ms) over the newest ``window`` completed requests — the
+    remediation layer's breach/recovery signal.  Whole-tape percentiles
+    (``stats()``) never recover from an early bad episode; a windowed
+    read answers "is it still slow NOW", which is what an SLO-tighten
+    decision (and its verification) needs."""
+    tape = sorted(r.latency_s for r in completed[-window:]
+                  if r.latency_s is not None)
+    if not tape:
+        return None
+    return round(percentile(tape, 0.99) * 1000.0, 3)
+
+
 def percentile(sorted_vals: list, q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (exact, no
     interpolation surprises in records)."""
@@ -221,6 +234,16 @@ class ContinuousBatcher:
         self.completed: list = []       # finished Requests (tape)
         self.rejected: list = []
         self.admitted_total = 0
+
+    def set_slo_ms(self, slo_ms: float) -> float:
+        """The remediation seam (resilience/remediate.py's slo_tighten
+        actuator): swap the live admission SLO and return the previous
+        value.  ``slo_ms`` is read per-admission, so the change takes
+        effect at the next step boundary — no drain, no restart, and
+        requests already admitted are unaffected (tightening admission
+        must never drop admitted work)."""
+        was, self.slo_ms = self.slo_ms, float(slo_ms)
+        return was
 
     # --- admission --------------------------------------------------------
     def _predicted_latency_s(self, req: Request, now: float) -> float:

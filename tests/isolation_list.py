@@ -23,6 +23,10 @@ ISOLATED_FILES = [
                             # subprocess — isolated for wall time, not
                             # collective-abort risk (the fast stdlib-child
                             # fleet tests stay inline in test_fleet.py)
+    "test_heal_drill.py",   # self-healing acceptance drills: faultline
+                            # children under remediation — isolated for
+                            # wall time; the guardrail/watcher/canary
+                            # tests stay inline in test_remediate.py
     "test_sched_drill.py",  # scheduler acceptance drill: faultline jobs
                             # (fresh jax per rank) under the control
                             # plane — isolated for wall time; the

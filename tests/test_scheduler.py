@@ -340,6 +340,186 @@ def test_scheduler_shrink_then_grow_policy(tmp_path):
     assert done and done[0]["rcs"] == {"0": 0, "1": 0}
 
 
+# ---- anomaly detections feed eviction policy (ROADMAP direction 5) -------
+
+def test_straggling_job_yields_to_queued_healthy_job(tmp_path):
+    """The heal rung: a 2-rank bench job whose rank 1 is NAMED
+    straggler by the fleet's monitor (lag + its own regression flag —
+    health files written by the children themselves, the detect_skew
+    contract) is evicted by the ANOMALY policy so an EQUAL-priority
+    queued train job gets the mesh — plain SLO preemption could never
+    justify this eviction (it only fires on strictly-less-urgent
+    victims), so the sched_evict row's why names the straggler.  The
+    bench requeues uncharged, relaunches clean (marker file drops the
+    straggle), and its progress tape is gap- and duplicate-free."""
+    py = sys.executable
+    prog = str(tmp_path / "progress")
+    child = _script(tmp_path, "strag.py", """
+        import json, os, signal, sys, time
+        signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+        rank = int(os.environ["OBS_RANK"])
+        hp = os.environ["OBS_HEALTH"]
+        prog = os.environ["PROG"]
+        once = os.environ["ONCE"] + f".r{rank}"
+        straggle = not os.path.exists(once)
+        open(once, "w").close()
+
+        def health(step, firing, ewma):
+            payload = {
+                "version": 1, "kind": "rank", "rank": rank,
+                "step": step, "updated_unix": time.time(),
+                "flags": {"step_time_regression":
+                          {"firing": firing,
+                           "fired_step": 3 if firing else None},
+                          "nan_loss": {"firing": False,
+                                       "fired_step": None},
+                          "loss_plateau": {"firing": False,
+                                           "fired_step": None}},
+                "detectors": {"step_time": {"ewma_s": ewma}}}
+            tmp = hp + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, hp)
+
+        for i in range(200):
+            if rank == 0:
+                # healthy front rank: advance + tape progress
+                n = (sum(1 for _ in open(prog))
+                     if os.path.exists(prog) else 0)
+                if n >= 10:
+                    health(100 + n, False, 0.01)
+                    time.sleep(5)       # wait for the gang's fate
+                    sys.exit(0)
+                with open(prog, "a") as f:
+                    f.write(f"i{n}\\n")
+                health(10 + n, False, 0.01)
+            elif straggle:
+                # frozen at step 2 with its own regression firing
+                health(2, True, 2.0)
+            else:
+                health(100 + i, False, 0.01)
+                if i > 10:
+                    sys.exit(0)
+            time.sleep(0.1)
+        sys.exit(0)
+    """)
+    jobs = [
+        Job(job="bench1", argv=[py, child], kind="bench", ranks=2,
+            fleet_retries=0, retries=2,
+            env={"PROG": prog, "ONCE": str(tmp_path / "once")}),
+        # equal priority, pinned: only the anomaly policy can evict
+        # for this job — the SLO evictor needs strictly-lower urgency.
+        Job(job="train1", argv=[py, "-c", "pass"], kind="train",
+            priority=20, ranks=2),
+    ]
+    summary = _sched(tmp_path, jobs).run()
+    assert summary["jobs"] == {"bench1": "done", "train1": "done"}
+    evict = _sched_rows(tmp_path, job="bench1", event="sched_evict")
+    assert len(evict) == 1 and evict[0]["for_job"] == "train1"
+    assert "straggler" in evict[0]["why"]
+    assert evict[0]["clean"] is True            # TERM→143, loss-free
+    heal = [r for r in _ledger_rows(tmp_path)
+            if str(r.get("event", "")).startswith("heal_")]
+    kinds = [r["event"] for r in heal]
+    assert "heal_detect" in kinds and "heal_evict" in kinds
+    detect = next(r for r in heal if r["event"] == "heal_detect")
+    assert detect["job"] == "bench1" and detect["kind"] == "straggler"
+    he = next(r for r in heal if r["event"] == "heal_evict")
+    assert he["detail"]["for_job"] == "train1"
+    # the victim's tape is exact across the eviction: nothing lost,
+    # nothing repeated
+    assert open(prog).read().split() == [f"i{i}" for i in range(10)]
+    # obs_query why folds both row families into one story
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_query
+    finally:
+        sys.path.pop(0)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert obs_query.main(["why", "bench1", "--ledger",
+                               str(tmp_path / "sched"
+                                   / "RUNS.jsonl")]) == 0
+    out = buf.getvalue()
+    assert "anomaly detected: straggler" in out
+    assert "HEALED by eviction" in out
+    assert "self-healed 1x (evict)" in out
+
+
+def test_heal_dry_run_detects_but_never_evicts(tmp_path, monkeypatch):
+    """HEAL_DRY_RUN: the same straggling gang is DETECTED (heal_detect
+    + heal_dry_run rows) but nothing stops it — the bench runs to its
+    own completion and the queued job simply waits."""
+    monkeypatch.setenv("HEAL_DRY_RUN", "1")
+    py = sys.executable
+    child = _script(tmp_path, "strag_dry.py", """
+        import json, os, signal, sys, time
+        signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+        rank = int(os.environ["OBS_RANK"])
+        hp = os.environ["OBS_HEALTH"]
+        t0 = time.time()
+        i = 0
+        while time.time() - t0 < 4.0:
+            payload = {
+                "version": 1, "kind": "rank", "rank": rank,
+                "step": (2 if rank else 50 + i),
+                "updated_unix": time.time(),
+                "flags": {"step_time_regression":
+                          {"firing": rank == 1, "fired_step":
+                           2 if rank == 1 else None},
+                          "nan_loss": {"firing": False,
+                                       "fired_step": None},
+                          "loss_plateau": {"firing": False,
+                                           "fired_step": None}},
+                "detectors": {"step_time":
+                              {"ewma_s": 2.0 if rank else 0.01}}}
+            tmp = hp + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, hp)
+            i += 1
+            time.sleep(0.1)
+        sys.exit(0)
+    """)
+    jobs = [
+        Job(job="bench1", argv=[py, child], kind="bench", ranks=2,
+            fleet_retries=0),
+        Job(job="train1", argv=[py, "-c", "pass"], kind="train",
+            priority=20, ranks=2),
+    ]
+    summary = _sched(tmp_path, jobs).run()
+    assert summary["jobs"] == {"bench1": "done", "train1": "done"}
+    assert not _sched_rows(tmp_path, job="bench1", event="sched_evict")
+    heal = [r["event"] for r in _ledger_rows(tmp_path)
+            if str(r.get("event", "")).startswith("heal_")]
+    assert "heal_detect" in heal and "heal_dry_run" in heal
+    assert "heal_evict" not in heal
+
+
+def test_heal_intent_replay_before_any_job_runs_is_clean_noop(tmp_path):
+    """A scheduler SIGKILLed between the remediator's heal_intent and
+    its applied row: the next incarnation re-applies the intent during
+    construction, through _heal_evict, while every job is still queued
+    — the documented idempotent noop ("job not running"), never an
+    error row from half-initialized scheduler state."""
+    workdir = tmp_path / "sched"
+    workdir.mkdir(parents=True)
+    dead = Journal(str(workdir / "sched.jsonl"))
+    dead.write("heal_detect", key="a:l0:straggler:rank0",
+               kind="straggler", job="a")
+    dead.write("heal_intent", seq=1, action="evict",
+               key="a:l0:straggler:rank0", kind="straggler", job="a")
+    sched = _sched(tmp_path, [Job(job="a", argv=[sys.executable,
+                                                 "-c", "pass"])])
+    heal = [r for r in sched.journal.events()
+            if str(r.get("event", "")).startswith("heal_")]
+    assert not any(r.get("error") for r in heal)
+    sup = [r for r in heal if r["event"] == "heal_suppressed"]
+    assert sup and sup[-1]["reason"].startswith("noop")
+
+
 # ---- write-ahead journal: SIGKILL mid-decision + orphan sweep ------------
 
 def test_sigkill_mid_decision_replays_and_sweeps_orphans(tmp_path):

@@ -136,11 +136,49 @@ def outage_rounds(records_dir: str) -> set:
 
 
 def _lower_is_better(metric: str) -> bool:
-    """Latency-family metrics (the serving p50/p99 ``*_ms`` lines)
-    regress UPWARD — the throughput rule inverted, or a 26% latency
-    improvement would gate as an 'unexplained drop' while a real
-    regression sailed through."""
+    """Latency-family metrics (the serving p50/p99 ``*_ms`` lines, the
+    heal family's mttd/mttr) regress UPWARD — the throughput rule
+    inverted, or a 26% latency improvement would gate as an
+    'unexplained drop' while a real regression sailed through."""
     return metric.endswith("_ms")
+
+
+def check_zero_invariants(records: list[dict],
+                          outages: set = frozenset()) -> list[dict]:
+    """Must-be-zero metrics: the heal family's ``*_lost`` lines
+    (steps_lost, requests_lost).  A nonzero value is an UNEXPLAINED
+    finding regardless of tolerance or noise — a remediation drill
+    that lost a step is a broken resume protocol, not a slow one.
+    Gated on the NEWEST record per (metric, platform) only, with the
+    same OUTAGE_r<N>.md adjudication the throughput ratchet honors: a
+    historical nonzero that a later round fixed (or a documented
+    degraded window) must not stay red forever."""
+    series: dict = {}
+    for rec in records:
+        metric = rec.get("metric", "")
+        if metric.endswith("_lost"):
+            series.setdefault((metric, _platform(rec)), []).append(rec)
+    findings = []
+    for (metric, platform), recs in sorted(series.items()):
+        rec = recs[-1]
+        v = rec.get("value")
+        if v in (0, 0.0):
+            continue
+        base = {"metric": metric, "platform": platform,
+                "newest": v, "newest_file": rec["_file"],
+                "prior": 0, "prior_file": "(invariant)",
+                "drop_frac": None}
+        if rec["_round"] in outages:
+            findings.append({**base, "severity": "explained",
+                             "why": f"round {rec['_round']} window is a "
+                                    f"documented outage (see OUTAGE_r"
+                                    f"{rec['_round']:02d}.md)"})
+            continue
+        findings.append({**base, "severity": "regression",
+                         "why": "must-be-zero invariant: a heal drill "
+                                "losing work means the resume protocol "
+                                "broke, not that the window was slow"})
+    return findings
 
 
 def compare_records(records: list[dict], tolerance: float,
@@ -153,6 +191,10 @@ def compare_records(records: list[dict], tolerance: float,
     magnitude, whichever direction that metric worsens in."""
     series: dict = {}
     for rec in records:
+        if rec.get("metric", "").endswith("_lost"):
+            # check_zero_invariants owns the must-be-zero family: here
+            # a fixed loss (1 -> 0) would read as a 100% "drop".
+            continue
         series.setdefault((rec["metric"], _platform(rec)), []).append(rec)
     findings = []
     for (metric, platform), recs in sorted(series.items()):
@@ -315,12 +357,15 @@ def build_trajectory(records_dir: str) -> list[dict]:
     checked-in artifact diffs like code."""
     rows: list[dict] = []
     # SCHED_* is the scheduler's queue-completion record family
-    # (tools/schedule.py --record) and SERVE_* the serving bench family
-    # (bench_serving.py throughput-vs-SLO curves): the same metric-row
-    # dialect as the bench families, so the control plane's and the
-    # serving path's throughput ride the same trajectory/ratchet
-    # surface as every other measured thing.
-    for pattern in ("BENCH_*.json", "SCHED_*.json", "SERVE_*.json"):
+    # (tools/schedule.py --record), SERVE_* the serving bench family
+    # (bench_serving.py throughput-vs-SLO curves), and HEAL_* the
+    # remediation-drill family (tools/heal_drill.py mttd/mttr/
+    # steps-lost): the same metric-row dialect as the bench families,
+    # so the control plane's, the serving path's, and the self-healing
+    # layer's numbers ride the same trajectory/ratchet surface as
+    # every other measured thing.
+    for pattern in ("BENCH_*.json", "SCHED_*.json", "SERVE_*.json",
+                    "HEAL_*.json"):
         for path in sorted(glob.glob(os.path.join(records_dir,
                                                   pattern))):
             if os.path.basename(path) == _TRAJECTORY_NAME:
@@ -419,10 +464,13 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--records_dir", default=_REPO,
                    help="where the BENCH_*.json records live")
-    p.add_argument("--glob", default="BENCH_*.json,SERVE_*.json",
+    p.add_argument("--glob", default="BENCH_*.json,SERVE_*.json,"
+                                     "HEAL_*.json",
                    help="comma-separated record patterns the prior-"
-                        "record ratchet scans (the serving family "
-                        "regresses like any bench family)")
+                        "record ratchet scans (the serving and heal "
+                        "families regress like any bench family; heal "
+                        "*_ms metrics gate lower-is-better and *_lost "
+                        "must stay zero)")
     p.add_argument("--baseline", default="",
                    help="BASELINE_SELF.json (default: in records_dir)")
     p.add_argument("--tolerance", type=float, default=0.10,
@@ -474,6 +522,7 @@ def main(argv: list[str] | None = None) -> int:
     outages = outage_rounds(args.records_dir)
     findings = compare_records(records, args.tolerance, args.noise,
                                outages)
+    findings += check_zero_invariants(records, outages)
     findings += compare_baseline(records, baselines, args.tolerance,
                                  outages)
     armed = armed_predictions(baselines, records)
@@ -498,10 +547,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  [trajectory] {trajectory_rows} family-round rows "
                   f"written")
         for f_ in findings:
+            worse = ("invariant violated"
+                     if f_["drop_frac"] is None
+                     else f"worse by {f_['drop_frac']:.1%}")
             print(f"  [{f_['severity']}] {f_['metric']} ({f_['platform']}):"
                   f" {f_['prior']:g} ({f_['prior_file']}) -> "
                   f"{f_['newest']:g} ({f_['newest_file']}), "
-                  f"worse by {f_['drop_frac']:.1%} — {f_['why']}")
+                  f"{worse} — {f_['why']}")
         if not findings:
             print("  no drops beyond tolerance")
         for a in armed:

@@ -293,13 +293,53 @@ _TERMINAL_WHY = {"sched_done": "completed", "sched_fail": "failed",
                  "sched_quarantine": "quarantined",
                  "sched_refuse": "refused"}
 
+# Renderers for the remediation engine's heal_* ledger rows — one entry
+# per decision class resilience/remediate.py can write; unknown heal_*
+# rows render generically (same contract as the sched_* table above).
+# KEEP-IN-SYNC(heal-events) digest=b5297afabbec
+_HEAL_RENDER = {
+    "heal_detect": lambda r: (
+        f"anomaly detected: {r.get('kind')}"
+        + (f" on rank {r.get('rank')}" if r.get("rank") is not None
+           else "")
+        + (f" at step {r.get('step')}" if r.get("step") is not None
+           else "") + f" (source {r.get('source')})"),
+    "heal_evict": lambda r: (
+        f"HEALED by eviction ({r.get('kind')}): loss-free gang stop — "
+        f"TERM→143→snapshot, resumed bitwise ({r.get('detail')})"),
+    "heal_rollback": lambda r: (
+        f"HEALED by rollback ({r.get('kind')}): gang rolled back to "
+        f"pinned last-good snapshot ({r.get('detail')})"),
+    "heal_slo_tighten": lambda r: (
+        f"HEALED by admission tightening ({r.get('kind')}): "
+        f"{r.get('detail')}"),
+    "heal_quarantine": lambda r: (
+        f"QUARANTINED rank {r.get('rank')} (repeated offender): "
+        f"{r.get('detail')}"),
+    "heal_canary_promote": lambda r: (
+        f"canary PROMOTED: {r.get('detail')}"),
+    "heal_canary_rollback": lambda r: (
+        f"canary ROLLED BACK ({r.get('kind')}): {r.get('detail')}"),
+    "heal_suppressed": lambda r: (
+        f"action {r.get('action')} on {r.get('kind')} SUPPRESSED by "
+        f"guardrail: {r.get('reason')}"),
+    "heal_dry_run": lambda r: (
+        f"DRY RUN: {r.get('action')} on {r.get('kind')} would have "
+        f"fired (HEAL_DRY_RUN armed — nothing ran)"),
+    "heal_budget_exhausted": lambda r: (
+        f"action budget {r.get('budget')} EXHAUSTED — remediation "
+        f"degraded to detection-only"),
+}
+# KEEP-IN-SYNC-END(heal-events)
+
 
 def why_rows(rows: list[dict], token: str) -> tuple[str, list[dict]]:
     """Resolve ``token`` (exact id or unique prefix) against the
-    distinct job ids in the ledger's sched_* rows; return (job_id,
-    that job's rows in ledger order)."""
+    distinct job ids in the ledger's sched_* AND heal_* rows; return
+    (job_id, that job's rows in ledger order) — one timeline holding
+    the scheduler's decisions and the remediation engine's."""
     sched = [r for r in rows
-             if str(r.get("event", "")).startswith("sched_")
+             if str(r.get("event", "")).startswith(("sched_", "heal_"))
              and r.get("job")]
     jobs = []
     for r in sched:
@@ -325,17 +365,32 @@ def cmd_why(args) -> int:
     job, mine = why_rows(rows, args.job)
     lines = []
     for r in mine:
-        render = _WHY_RENDER.get(r.get("event"))
-        text = (render(r) if render else
-                f"{r.get('event')}: " + json.dumps(
-                    {k: v for k, v in r.items()
-                     if k not in ("v", "ts", "event", "src", "job")},
-                    sort_keys=True, default=str))
+        ev_name = str(r.get("event", ""))
+        if ev_name.startswith("heal_") and r.get("error"):
+            # An applied row carrying error= balances the remediator's
+            # WAL but the actuator CRASHED — rendering it through the
+            # HEALED renderer would tell the operator a heal happened.
+            text = (f"action {ev_name.removeprefix('heal_')} FAILED "
+                    f"({r.get('kind')}): {r.get('error')}")
+        else:
+            render = _WHY_RENDER.get(r.get("event")) \
+                or _HEAL_RENDER.get(r.get("event"))
+            text = (render(r) if render else
+                    f"{r.get('event')}: " + json.dumps(
+                        {k: v for k, v in r.items()
+                         if k not in ("v", "ts", "event", "src", "job")},
+                        sort_keys=True, default=str))
         lines.append({"ts": r.get("ts"), "event": r.get("event"),
                       "text": text})
     evictions = sum(1 for r in mine if r.get("event") == "sched_evict")
     shrinks = sum(1 for r in mine if r.get("event") == "sched_shrink")
     grows = sum(1 for r in mine if r.get("event") == "sched_grow")
+    heals = [r for r in mine
+             if str(r.get("event", "")).startswith("heal_")
+             and not r.get("error")
+             and r.get("event") not in ("heal_detect", "heal_suppressed",
+                                        "heal_dry_run",
+                                        "heal_budget_exhausted")]
     last_terminal = next(
         (r for r in reversed(mine) if r.get("event") in _TERMINAL_WHY),
         None)
@@ -349,6 +404,11 @@ def cmd_why(args) -> int:
         verdict.append(f"shrank {shrinks}x on rank loss")
     if grows:
         verdict.append(f"grew back {grows}x on recovery")
+    if heals:
+        kinds = sorted({str(r["event"]).removeprefix("heal_")
+                        for r in heals})
+        verdict.append(f"self-healed {len(heals)}x "
+                       f"({', '.join(kinds)})")
     verdict.append(
         f"finally {_TERMINAL_WHY[last_terminal['event']]}"
         if last_terminal else "no terminal decision on record "

@@ -43,7 +43,17 @@ attempt; per-rank flight files land in OBS_DIR (default
 <workdir>/fleet.jsonl`` (add ``--format trace > fleet.trace.json`` for
 a Perfetto-loadable cross-rank timeline).
 
-Online health (detection only): every rank gets OBS_HEALTH exported, so
+Round 16 (`--heal`): detection closes the loop.  The remediation
+policy engine (resilience/remediate.py, DESIGN.md §23) watches the
+same health files + ledger rows and acts through guardrailed policies:
+straggler/regression → loss-free stop + bitwise resume, NaN/plateau →
+rollback to the pinned last-good snapshot, repeated host loss → rank
+quarantine.  Every decision is a ``heal_*`` ledger row
+(``obs_query why <name>`` renders the timeline); HEAL_DRY_RUN=1
+journals without acting.  Without --heal the round-10 stance below is
+unchanged.
+
+Online health (detection only without --heal): every rank gets OBS_HEALTH exported, so
 its AnomalyHook writes <workdir>/health_rank<r>.json; the fleet's
 monitor loop reads those, flags stragglers/skew
 (obs/anomaly.detect_skew), annotates the journal with ``anomaly``
@@ -82,8 +92,11 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from distributedtensorflowexample_tpu.obs import recorder as obs_recorder  # noqa: E402
+from distributedtensorflowexample_tpu.resilience import (  # noqa: E402
+    remediate)
 from distributedtensorflowexample_tpu.resilience.fleet import (  # noqa: E402
-    FleetSupervisor, RankLossRefused, RankLossStructurallyIllegal)
+    FleetSupervisor, RankLossRefused, RankLossStructurallyIllegal,
+    resolve_ledger_dest)
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: E402
     Journal, RetryPolicy)
 
@@ -160,6 +173,21 @@ def main(argv: list[str] | None = None) -> int:
                         "own OBS_LEDGER export still wins, for ranks "
                         "AND fleet rows alike) — query with "
                         "tools/obs_query.py list/diff --ledger <path>")
+    p.add_argument("--heal", action="store_true",
+                   help="self-healing mode (resilience/remediate.py): "
+                        "watch the per-rank health files + ledger "
+                        "anomaly rows while the gang runs, and close "
+                        "the loop — straggler/regression → loss-free "
+                        "stop + bitwise resume, NaN/plateau → rollback "
+                        "to the pinned last-good snapshot, repeated "
+                        "host loss → rank quarantine.  Guardrailed "
+                        "(HEAL_FLAP_N/HEAL_COOLDOWN_S/"
+                        "HEAL_ACTION_BUDGET) and HEAL_DRY_RUN=1 "
+                        "journals decisions without acting")
+    p.add_argument("--heal_poll_s", type=float, default=0.25,
+                   help="remediation watcher cadence under --heal")
+    p.add_argument("--max_heals", type=int, default=4,
+                   help="heal-driven relaunches before giving up")
     p.add_argument("--seed", type=int, default=None,
                    help="backoff-jitter seed (tests)")
     args = p.parse_args(argv)
@@ -178,30 +206,86 @@ def main(argv: list[str] | None = None) -> int:
     os.makedirs(os.environ["OBS_DIR"], exist_ok=True)
     obs_recorder.install(sigterm=False)
 
-    fleet = FleetSupervisor(
-        args.num_ranks,
-        policy=RetryPolicy(retries=args.retries,
-                           backoff_base_s=args.backoff_base_s,
-                           backoff_max_s=args.backoff_max_s),
-        journal=Journal(args.journal
-                        or os.path.join(workdir, "fleet.jsonl")),
-        heartbeat_timeout_s=args.heartbeat_timeout_s,
-        wall_timeout_s=args.timeout_s,
-        kill_grace_s=args.kill_grace_s,
-        preempt_grace_s=args.preempt_grace_s,
-        seed=args.seed,
-        elastic=args.elastic,
-        worker_tiled=(args.sync_mode == "async"),
-        workdir=workdir,
-        health_path=("" if args.health == "none" else args.health or None),
-        skew_lag_steps=args.skew_lag_steps,
-        skew_time_ratio=args.skew_time_ratio,
-        ledger_path=("" if args.ledger == "none" else args.ledger or None),
-        http=args.http)
+    journal = Journal(args.journal
+                      or os.path.join(workdir, "fleet.jsonl"))
+
+    def make_fleet() -> FleetSupervisor:
+        return FleetSupervisor(
+            args.num_ranks,
+            policy=RetryPolicy(retries=args.retries,
+                               backoff_base_s=args.backoff_base_s,
+                               backoff_max_s=args.backoff_max_s),
+            journal=journal,
+            heartbeat_timeout_s=args.heartbeat_timeout_s,
+            wall_timeout_s=args.timeout_s,
+            kill_grace_s=args.kill_grace_s,
+            preempt_grace_s=args.preempt_grace_s,
+            seed=args.seed,
+            elastic=args.elastic,
+            worker_tiled=(args.sync_mode == "async"),
+            workdir=workdir,
+            health_path=("" if args.health == "none"
+                         else args.health or None),
+            skew_lag_steps=args.skew_lag_steps,
+            skew_time_ratio=args.skew_time_ratio,
+            ledger_path=("" if args.ledger == "none"
+                         else args.ledger or None),
+            http=args.http)
+
     try:
-        res = fleet.run(child, name=args.name,
-                        snapshot_dir_template=snapshots,
-                        stdout_dir=args.stdout_dir or workdir)
+        if args.heal:
+            # Self-healing mode: the policy engine watches the same
+            # telemetry the monitor writes and drives the actuators the
+            # fleet already has — one journal holds the fleet's AND the
+            # remediator's WAL, one ledger both row families.  The
+            # shared resolution rule (fleet.resolve_ledger_dest) keeps
+            # the remediator bound to the SAME file the fleet's
+            # anomaly/rank_lost rows land in.
+            ledger_path = resolve_ledger_dest(
+                "" if args.ledger == "none"
+                else args.ledger or os.path.join(workdir, "RUNS.jsonl"))
+            target = remediate.FleetTarget()
+            actuators = {
+                "evict": remediate.make_evict_actuator(target),
+                "quarantine": remediate.make_quarantine_actuator(target),
+            }
+            if snapshots:
+                actuators["rollback"] = remediate.make_rollback_actuator(
+                    snapshots, target=target)
+            rem = remediate.Remediator(
+                journal=journal, ledger_path=ledger_path,
+                actuators=actuators, scope=args.name or "fleet")
+            watchers = [
+                remediate.HealthWatcher(
+                    os.path.join(workdir, "health_rank*.json"),
+                    fleet_health=("" if args.health == "none"
+                                  else args.health
+                                  or os.path.join(workdir,
+                                                  "health.json")),
+                    scope=args.name or "fleet"),
+            ]
+            if ledger_path:
+                # rank_lost ONLY: the ledger's `anomaly` rows mirror
+                # the same conditions the health files already deliver
+                # — tailing both would double-count one condition into
+                # one guardrail key and cross the flap bar in a single
+                # poll cycle.
+                watchers.append(remediate.LedgerWatcher(
+                    ledger_path, kinds=("rank_lost",),
+                    scope=args.name or "fleet"))
+            out = remediate.run_remediated(
+                make_fleet, child, rem, watchers, target=target,
+                name=args.name, snapshot_dir_template=snapshots,
+                stdout_dir=args.stdout_dir or workdir,
+                poll_s=args.heal_poll_s, max_heals=args.max_heals)
+            res = out["results"][-1]
+            print(f"supervise_fleet: heal: {out['healed']} relaunch(es), "
+                  f"{rem.guardrails.actions_used} action(s), final "
+                  f"status {out['status']}", file=sys.stderr, flush=True)
+        else:
+            res = make_fleet().run(child, name=args.name,
+                                   snapshot_dir_template=snapshots,
+                                   stdout_dir=args.stdout_dir or workdir)
     except RankLossStructurallyIllegal as e:
         print(f"supervise_fleet: {e}", file=sys.stderr, flush=True)
         return 4
