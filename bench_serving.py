@@ -2,7 +2,7 @@
 """bench_serving — the serving-path bench family: closed-loop
 throughput, p50/p99 latency, and the throughput-vs-SLO curve.
 
-Three instruments over one engine (serving/):
+Six instruments over one engine family (serving/):
 
 1. **Supervised headline** (default on): a REAL ``tools/serve_lm.py``
    worker runs as a child of the resilience Supervisor — heartbeat
@@ -20,6 +20,19 @@ Three instruments over one engine (serving/):
    p50/p99 of the accepted work, and the rejection rate at each
    operating point — the throughput-vs-SLO curve the round-15 record
    checks in.
+4. **Params-stay-sharded point** (round 17): ``promote_sharded`` +
+   ``ShardedDecodeEngine`` at a D-device mesh — closed-loop tokens/sec
+   with params resident at 1/D, plus the residency measured from LIVE
+   shardings (``params_residency``), including the lm_base/D=4
+   instrument the round-12 training-side claim used.
+5. **Speculative draft-k sweep** (round 17): self-draft (same
+   snapshot drafts → full acceptance, the machinery's upper bound)
+   against the SAME workload decoded plain-greedy — tokens/sec,
+   acceptance length, and a ``*_mismatch`` column tools/bench_ratchet.py
+   holds at ZERO (spec output is bitwise greedy by construction).
+6. **Batched-prefill amortization** (round 17): one ``prefill_many``
+   call over a same-bucket burst vs the same prompts prefilled solo —
+   the per-request speedup continuous batching's admission path banks.
 
 CPU numbers calibrate the machinery and arm chip predictions (the
 armed_predictions_round15_serving block in BASELINE_SELF.json);
@@ -93,6 +106,36 @@ def _run_point(engine, *, requests: int, clients: int, max_new: int,
             "step_ewma_ms": stats["step_ewma_ms"]}
 
 
+def _oracle_run(engine, prompts, *, spec=None, repeats=3) -> tuple:
+    """Decode ``prompts`` to completion through a fresh batcher
+    (optionally speculative): submit-all-then-step keeps the workload
+    IDENTICAL across configurations, so the returned token map diffs
+    bitwise against another configuration's (the ``*_mismatch``
+    column).  Returns ``(tokens_by_rid, [tokens/sec per repeat])`` —
+    repeat 0 pays the cold compiles and is dropped by callers."""
+    from distributedtensorflowexample_tpu.serving.queue import (
+        ContinuousBatcher, RequestQueue)
+    toks_by_rid: dict = {}
+    rates: list = []
+    for _ in range(max(1, repeats)):
+        queue = RequestQueue(engine.vocab)
+        b = ContinuousBatcher(engine, queue, slo_ms=0.0, spec=spec)
+        reqs = [queue.submit(p, m, rid=f"o{i}")
+                for i, (p, m) in enumerate(prompts)]
+        t0 = time.monotonic()
+        while any(not r.done.is_set() for r in reqs):
+            b.step()
+        wall = time.monotonic() - t0
+        prev, toks_by_rid = toks_by_rid, {r.rid: list(r.tokens)
+                                          for r in reqs}
+        if prev and prev != toks_by_rid:
+            raise AssertionError(
+                "oracle workload not deterministic across repeats")
+        total = sum(len(r.tokens) for r in reqs)
+        rates.append(round(total / wall, 3) if wall > 0 else 0.0)
+    return toks_by_rid, rates
+
+
 def _supervised_headline(args, snapshot: str, workdir: str) -> dict:
     """The end-to-end point: serve_lm under the Supervisor, heartbeat
     armed, driven by its own closed loop; returns its stats JSON plus
@@ -149,6 +192,16 @@ def main(argv: list[str] | None = None) -> int:
                         "includes worker cold-start, so its own "
                         "spread_frac matters)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host_devices", type=int, default=8,
+                   help="CPU calibration: force this many host devices "
+                        "so the sharded point has a mesh (0 = leave "
+                        "XLA_FLAGS alone; ignored under --real)")
+    p.add_argument("--sharded_mesh", type=int, default=0,
+                   help="mesh size D for the params-stay-sharded point "
+                        "(0 = auto: 4 if available, else 2, else skip)")
+    p.add_argument("--spec_k_sweep", default="2,4",
+                   help="draft window sizes for the speculative sweep "
+                        "(empty = skip)")
     p.add_argument("--skip_supervised", action="store_true",
                    help="skip the supervised end-to-end headline "
                         "(in-process sweeps only)")
@@ -162,6 +215,15 @@ def main(argv: list[str] | None = None) -> int:
                           args.clients_sweep.split(",") if x]
     args.slo_sweep_ms = [float(x) for x in
                          args.slo_sweep_ms.split(",") if x]
+    args.spec_k_sweep = [int(x) for x in
+                         args.spec_k_sweep.split(",") if x]
+
+    if args.host_devices > 1 and not args.real:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.host_devices}").strip()
 
     import jax
     if not args.real:
@@ -188,7 +250,11 @@ def main(argv: list[str] | None = None) -> int:
     obs_serve.maybe_start()
     os.makedirs(args.workdir, exist_ok=True)
     snapshot = args.snapshot or os.path.join(args.workdir, "snaps")
-    requests = args.requests or max(128, load_requests_default() * 8)
+    # Resolve the default BEFORE the supervised section reads
+    # args.requests — `--drive 0` tells the worker to serve forever,
+    # which turns the headline into a heartbeat-fed hang.
+    requests = args.requests = (
+        args.requests or max(128, load_requests_default() * 8))
     platform = jax.default_backend()
     size = args.size
     lines: list = []
@@ -242,6 +308,7 @@ def main(argv: list[str] | None = None) -> int:
             traceback.print_exc()
 
     # 2 + 3. in-process sweeps (one engine, one compile set) --------------
+    pm = engine = None
     try:
         pm = promote(snapshot, size)
         engine = DecodeEngine(pm.model, pm.params, slots=args.slots,
@@ -319,6 +386,201 @@ def main(argv: list[str] | None = None) -> int:
                        "capacity trade"}, lines)
     except Exception as e:
         errors["sweep"] = repr(e)
+        traceback.print_exc()
+
+    # 4. params-stay-sharded point ----------------------------------------
+    try:
+        import numpy as np
+        from distributedtensorflowexample_tpu.serving.promote import (
+            promote_sharded)
+        from distributedtensorflowexample_tpu.serving.sharded import (
+            ShardedDecodeEngine)
+        ndev = len(jax.devices())
+        D = args.sharded_mesh or (4 if ndev >= 4 else 2)
+        if ndev < 2 or D > ndev or args.slots % D:
+            errors["sharded"] = (f"needs a divisible mesh: devices="
+                                 f"{ndev}, D={D}, slots={args.slots}")
+        else:
+            spm = promote_sharded(snapshot, size, mesh_size=D)
+            seng = ShardedDecodeEngine(spm.model, spm.rows, spm.layout,
+                                       slots=args.slots,
+                                       cache_len=args.max_len)
+            res = seng.params_residency()
+            _run_point(seng, requests=max(8, 2 * args.slots), clients=2,
+                       max_new=args.max_new, slo_ms=0.0,
+                       seed=args.seed + 555)       # compiles out of the tape
+            sh_reps, sh_pts = [], []
+            for r in range(max(1, args.repeats)):
+                pt = _run_point(seng, requests=requests,
+                                clients=args.clients_sweep[-1],
+                                max_new=args.max_new, slo_ms=0.0,
+                                seed=args.seed + 31 + r)
+                sh_reps.append(pt["goodput_tokens_per_sec"])
+                sh_pts.append(pt)
+            sb = max(range(len(sh_reps)), key=lambda i: sh_reps[i])
+            _emit(f"serve_{size}_sharded_tokens_per_sec", sh_reps[sb],
+                  "tokens/sec",
+                  {**shared, "mesh_size": D, "repeats": sh_reps,
+                   "spread_frac": round(spread_fraction(sh_reps), 4),
+                   "p50_ms": sh_pts[sb]["p50_ms"],
+                   "p99_ms": sh_pts[sb]["p99_ms"],
+                   "residency": res,
+                   "snapshot_layout": spm.source_layout,
+                   "note": "params resident at 1/D (zero3 bucket rows), "
+                           "one all-gather per bucket INSIDE the "
+                           "compiled decode step (pinned by "
+                           "SHARDED_DECODE_HLO_CONTRACT); the CPU mesh "
+                           "is forced host devices, so this calibrates "
+                           "the gather machinery, never chip "
+                           "throughput"}, lines)
+            _emit(f"serve_{size}_sharded_params_frac_per_device",
+                  res["frac_per_device"], "fraction",
+                  {**shared, "mesh_size": D, **res,
+                   "expected": 1.0 / D}, lines)
+        if ndev >= 4:
+            # lm_base/D=4: the round-12 training-side residency claim
+            # re-measured on the SERVING engine's live shardings — the
+            # constructor device_puts the rows at 1/D, so reading the
+            # placement needs no decode compile of the 57M-param rung.
+            import jax.numpy as jnp
+            from distributedtensorflowexample_tpu.models.transformer_lm \
+                import build_lm
+            from distributedtensorflowexample_tpu.parallel.mesh import (
+                make_mesh)
+            from distributedtensorflowexample_tpu.parallel.zero3 import (
+                Zero3Layout)
+            bmodel = build_lm("lm_base", max_len=args.max_len)
+            bparams = bmodel.init(jax.random.PRNGKey(args.seed),
+                                  jnp.zeros((1, 8), jnp.int32))["params"]
+            bl = Zero3Layout(bparams, 8 << 20, make_mesh(4))
+            beng = ShardedDecodeEngine(bmodel, bl.init_rows(bparams), bl,
+                                       slots=4, cache_len=args.max_len)
+            bres = beng.params_residency()
+            _emit("serve_lm_base_sharded_params_frac_per_device",
+                  bres["frac_per_device"], "fraction",
+                  {"platform": platform, "size": "lm_base",
+                   "mesh_size": 4, **bres, "expected": 0.25,
+                   "note": "live-sharding residency of the 57M-param "
+                           "rung at D=4 (the acceptance instrument): "
+                           "bytes of the addressable shard vs bytes of "
+                           "the logical row, summed over buckets"},
+                  lines)
+            del beng, bl, bparams
+    except Exception as e:
+        errors["sharded"] = repr(e)
+        traceback.print_exc()
+
+    # 5. speculative draft-k sweep ----------------------------------------
+    try:
+        if engine is not None and args.spec_k_sweep:
+            import numpy as np
+            from distributedtensorflowexample_tpu.serving.engine import (
+                DecodeEngine)
+            from distributedtensorflowexample_tpu.serving.spec import (
+                SpecDecoder)
+            rng = np.random.default_rng(args.seed + 7)
+            n_req = max(16, 4 * args.slots)
+            prompts = [(rng.integers(1, engine.vocab, size=int(
+                rng.integers(4, 13))).astype(np.int32), args.max_new)
+                for _ in range(n_req)]
+            greedy_toks, greedy_rates = _oracle_run(engine, prompts)
+            greedy_tps = max(greedy_rates[1:] or greedy_rates)
+            draft = DecodeEngine(pm.model, pm.params, slots=args.slots,
+                                 cache_len=args.max_len)
+            sweep: list = []
+            mismatch_total = 0
+            for k in args.spec_k_sweep:
+                spec = SpecDecoder(engine, draft, k=k)
+                spec_toks, spec_rates = _oracle_run(engine, prompts,
+                                                    spec=spec)
+                tps = max(spec_rates[1:] or spec_rates)
+                mism = sum(1 for rid in greedy_toks
+                           if spec_toks.get(rid) != greedy_toks[rid])
+                mismatch_total += mism
+                st = spec.stats()
+                sweep.append({
+                    "k": k, "tokens_per_sec": tps,
+                    "repeats": spec_rates,
+                    "spread_frac": round(
+                        spread_fraction(spec_rates[1:] or spec_rates), 4),
+                    "accept_len_mean": st["accept_len_mean"],
+                    "rounds": st["rounds"], "mismatch": mism,
+                    "uplift_vs_greedy": (round(tps / greedy_tps, 4)
+                                         if greedy_tps else None)})
+            best = max(sweep, key=lambda s: s["tokens_per_sec"])
+            _emit(f"serve_{size}_spec_tokens_per_sec",
+                  best["tokens_per_sec"], "tokens/sec",
+                  {**shared, "k": best["k"], "requests": n_req,
+                   "spread_frac": best["spread_frac"],
+                   "greedy_tokens_per_sec": greedy_tps,
+                   "greedy_repeats": greedy_rates,
+                   "uplift_vs_greedy": best["uplift_vs_greedy"],
+                   "draft": f"{size} (self-draft)", "k_sweep": sweep,
+                   "note": "self-draft (same snapshot) = full "
+                           "acceptance, the machinery's upper bound: "
+                           "on CPU the draft steps cost target price, "
+                           "so the uplift here calibrates batched-"
+                           "verify dispatch amortization only — the "
+                           "chip prediction arms the LADDER draft "
+                           "(lm_tiny drafting lm_base at ~1/50th the "
+                           "step cost), see BASELINE_SELF.json"}, lines)
+            _emit(f"serve_{size}_spec_accept_len",
+                  best["accept_len_mean"] or 0.0, "tokens/round",
+                  {**shared, "k": best["k"], "k_sweep": sweep,
+                   "note": "mean tokens emitted per slot-round "
+                           "(accepted draft prefix + the verify step's "
+                           "own token); k+1 = full acceptance"}, lines)
+            _emit(f"serve_{size}_spec_mismatch", float(mismatch_total),
+                  "requests",
+                  {**shared, "requests_per_k": n_req, "k_sweep": sweep,
+                   "note": "speculative output vs plain greedy on the "
+                           "identical workload — the ratchet's "
+                           "must-be-zero family (*_mismatch): any "
+                           "nonzero is a broken acceptance rule, "
+                           "never noise"}, lines)
+    except Exception as e:
+        errors["spec"] = repr(e)
+        traceback.print_exc()
+
+    # 6. batched-prefill amortization -------------------------------------
+    try:
+        if engine is not None:
+            import numpy as np
+            rng = np.random.default_rng(args.seed + 17)
+            B = args.slots
+            bp = [rng.integers(1, engine.vocab,
+                               size=5 + (i % 4)).astype(np.int32)
+                  for i in range(B)]       # all land in the same bucket
+            for s in range(B):             # warm both shapes
+                engine.prefill(s, bp[s], 1)
+            engine.prefill_many([(s, bp[s], 1) for s in range(B)])
+            solo_times, batch_times = [], []
+            for _ in range(5):
+                t0 = time.monotonic()
+                for s in range(B):
+                    engine.prefill(s, bp[s], 1)
+                solo_times.append(time.monotonic() - t0)
+                t0 = time.monotonic()
+                engine.prefill_many([(s, bp[s], 1) for s in range(B)])
+                batch_times.append(time.monotonic() - t0)
+            solo, batched = min(solo_times), min(batch_times)
+            _emit(f"serve_{size}_prefill_batch_amortization",
+                  round(solo / batched, 4) if batched > 0 else 0.0, "x",
+                  {**shared, "batch": B,
+                   "solo_ms_per_request": round(solo / B * 1000.0, 4),
+                   "batched_ms_per_request":
+                       round(batched / B * 1000.0, 4),
+                   "solo_repeats_ms": [round(t * 1000.0, 3)
+                                       for t in solo_times],
+                   "batched_repeats_ms": [round(t * 1000.0, 3)
+                                          for t in batch_times],
+                   "note": "one prefill_many call over a same-bucket "
+                           "burst vs the same prompts prefilled solo "
+                           "(best of 5, warm): the admission path's "
+                           "burst amortization, also the term the "
+                           "SLO predictor prices per-request"}, lines)
+    except Exception as e:
+        errors["prefill_batch"] = repr(e)
         traceback.print_exc()
 
     if args.json:

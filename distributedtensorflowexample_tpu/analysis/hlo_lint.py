@@ -420,25 +420,46 @@ def mode_suite(bucket_bytes: int = 16 << 10) -> list[dict]:
 
 
 def serving_suite() -> list[dict]:
-    """Compile the serving decode step (lm_tiny, a small slot/cache
-    geometry — the contract is about STRUCTURE: donation aliasing, no
-    donated-parameter copy, no collectives, the f32 ceiling; none of it
-    scales with geometry) and pair it with the contract declared next
-    to the step builder (serving/engine.DECODE_HLO_CONTRACT)."""
+    """Compile the serving decode steps (lm_tiny, a small slot/cache
+    geometry — the contracts are about STRUCTURE: donation aliasing, no
+    donated-parameter copy, the collective budget, the f32 ceiling;
+    none of it scales with geometry) and pair each with the contract
+    declared next to its step builder: the replicated engine's
+    0-collective ``serve_decode`` and, on a multi-device process, the
+    params-stay-sharded ``serve_decode_sharded`` whose budget is
+    EXACTLY one all-gather per param bucket (symbol B resolves from the
+    compiled layout's plan, the zero3 idiom)."""
+    import jax
     import jax.numpy as jnp
     import optax
 
     from distributedtensorflowexample_tpu.models import build_model
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.zero3 import Zero3Layout
     from distributedtensorflowexample_tpu.serving.engine import (
         DECODE_HLO_CONTRACT, DecodeEngine)
+    from distributedtensorflowexample_tpu.serving.sharded import (
+        SHARDED_DECODE_HLO_CONTRACT, ShardedDecodeEngine)
     from distributedtensorflowexample_tpu.training.state import TrainState
 
     model = build_model("lm_tiny")
     state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
                               jnp.zeros((1, 8), jnp.int32))
     engine = DecodeEngine(model, state.params, slots=2, cache_len=16)
-    return [{"mode": "serve_decode", "hlo": engine.decode_hlo(),
-             "contract": DECODE_HLO_CONTRACT, "symbols": {}}]
+    out = [{"mode": "serve_decode", "hlo": engine.decode_hlo(),
+            "contract": DECODE_HLO_CONTRACT, "symbols": {}}]
+    if len(jax.devices()) >= 2:
+        mesh = make_mesh(2)
+        repl = jax.device_put(state.params, replicated_sharding(mesh))
+        layout = Zero3Layout(repl, 16 << 10, mesh)
+        sharded = ShardedDecodeEngine(model, layout.init_rows(repl),
+                                      layout, slots=2, cache_len=16)
+        out.append({"mode": "serve_decode_sharded",
+                    "hlo": sharded.decode_hlo(),
+                    "contract": SHARDED_DECODE_HLO_CONTRACT,
+                    "symbols": {"B": layout.num_buckets}})
+    return out
 
 
 def run_hlo_lint(bucket_bytes: int = 16 << 10) -> list[Finding]:

@@ -292,6 +292,7 @@ def fleet_dir_sources(flight_dir: str = "", journal: str = "",
 # --- Perfetto / Chrome-trace export ---------------------------------------
 
 _FLEET_LANE = 9999      # pid lane for rank-less events (fleet, bench)
+_SLOT_TRACK_BASE = 1000  # tid offset for serving decode-slot tracks
 
 
 def chrome_trace(merged: dict) -> dict:
@@ -328,6 +329,7 @@ def chrome_trace(merged: dict) -> dict:
                         "args": {"sort_index": pid}})
         return pid
 
+    slot_tids: set = set()
     for ev in events:
         rank = ev.get("rank")
         pid = _lane(rank, "fleet / unranked" if rank is None
@@ -336,13 +338,30 @@ def chrome_trace(merged: dict) -> dict:
         args = {k: v for k, v in ev.items()
                 if k not in ("name", "t0_s", "t0_unix", "dur_s", "depth",
                              "parent", "pid", "src", "rank")}
+        # Serving events carry a decode-slot attr: one Perfetto lane
+        # PER SLOT (tid offset past the attempt tracks), so a worker's
+        # request lifecycle (queue → prefill → decode) renders as slot
+        # occupancy over time instead of interleaving on one row.
+        slot = ev.get("slot")
+        if isinstance(slot, int) and slot >= 0:
+            tid = _SLOT_TRACK_BASE + slot
+            if (pid, tid) not in slot_tids:
+                slot_tids.add((pid, tid))
+                out.append({"ph": "M", "pid": pid, "tid": tid,
+                            "name": "thread_name",
+                            "args": {"name": f"slot {slot}"}})
+                out.append({"ph": "M", "pid": pid, "tid": tid,
+                            "name": "thread_sort_index",
+                            "args": {"sort_index": tid}})
+        else:
+            tid = int(attempt) if str(attempt).isdigit() else 0
         out.append({"ph": "X", "pid": pid,
                     # One track per attempt: restarts render as separate
                     # rows instead of interleaving with the run they
                     # replaced.  Same-track nesting comes from span
                     # containment, which the thread-local span stack
                     # guarantees within one attempt.
-                    "tid": int(attempt) if str(attempt).isdigit() else 0,
+                    "tid": tid,
                     "name": str(ev.get("name")),
                     "ts": round((ev["t0_unix"] - base) * 1e6, 1),
                     "dur": round((ev.get("dur_s") or 0.0) * 1e6, 1),

@@ -38,6 +38,7 @@ not be poisoned by one bad request.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import flax.linen as nn
@@ -85,8 +86,22 @@ class ServingBlock(nn.Module):
     names (``ln1``/``qkv``/``attn_out``/``ln2``/``mlp_in``/``mlp_out``)
     so the training param tree binds unchanged, and two methods:
     :meth:`prefill` (full causal attention — the training forward's
-    einsums verbatim, plus the K/V it produced) and :meth:`decode`
-    (single-query attention against the slot's cache rows)."""
+    einsums verbatim, plus the K/V it produced) and :meth:`verify`
+    (a K-token teacher-forced window against the slot's cache rows;
+    plain decode is the K == 1 window).
+
+    There is deliberately NO separate single-query decode method.  An
+    earlier revision had one, and its einsums ("shd,sthd->sht") were a
+    DIFFERENT compiled structure from the window's ("skhd,sthd->shkt")
+    — close enough to agree almost always, far enough that on the bf16
+    logit grid a near-tied argmax could flip between the two programs
+    (observed: two tokens both at logit 2.59375, decode picking one,
+    verify the other).  Speculative decoding's bitwise-greedy oracle
+    cannot rest on two programs that may disagree at ties, so decode IS
+    verify at K == 1: one program family, one numerics, and the only
+    cross-shape assumption left — per-element stability when K is a
+    pure batch dimension — is the same one bucketed prefill already
+    relies on (B=1 vs B=3 prompts bitwise, pinned in tests)."""
     d_model: int
     n_heads: int
     d_ff: int
@@ -131,36 +146,47 @@ class ServingBlock(nn.Module):
         x = x + self.attn_out(att)
         return self._mlp(x), k, v
 
-    def decode(self, x, ck, cv, pos):
-        """One token per slot: x [S, d], cache rows ck/cv [S, T, H, Dh],
-        pos [S] (the row this step writes, = each slot's sequence
-        length so far).  The new K/V scatter at ``pos`` precedes the
-        attention read, so the current token attends to itself like the
-        training forward's diagonal; rows past ``pos`` are masked to
-        -1e9, which the f32 exp maps to exactly 0.0 — stale cache
-        content beyond a slot's frontier can never leak into its
-        output."""
-        S, T = ck.shape[0], ck.shape[1]
+    def verify(self, x, ck, cv, pos):
+        """A K-token window per slot: x [S, K, d], cache rows ck/cv
+        [S, T, H, Dh], pos [S] (the row the window starts at).  The
+        window's K/V scatter at rows ``pos..pos+K-1`` precedes the
+        read; window query j attends rows ``<= pos+j`` — the decode
+        mask extended one causal diagonal into the window.
+
+        This is the ONLY token-step program: plain decode is this
+        window at K == 1 (:meth:`ServingBlock.decode` was deleted for
+        cause — see the class docstring).  Window query j's math per
+        (slot, head, query) touches K only as a batch dimension, so its
+        argmax equals what j sequential K == 1 steps would have
+        produced under the same kernel-batch-stability that already
+        underwrites bucketed prefill (pinned bitwise in
+        tests/test_serving.py).  A slot parked at ``pos == T`` scatters
+        out of bounds (dropped) and its outputs are garbage by
+        construction — callers discard non-busy rows."""
+        S, K, _ = x.shape
+        T = ck.shape[1]
         Dh = self.d_model // self.n_heads
         h = self.ln1(x)
         qkv = self.qkv(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(S, self.n_heads, Dh)
-        k = k.reshape(S, self.n_heads, Dh)
-        v = v.reshape(S, self.n_heads, Dh)
-        sl = jnp.arange(S)
-        ck = ck.at[sl, pos].set(k)
-        cv = cv.at[sl, pos].set(v)
-        scores = jnp.einsum("shd,sthd->sht", q, ck) / jnp.asarray(
+        q = q.reshape(S, K, self.n_heads, Dh)
+        k = k.reshape(S, K, self.n_heads, Dh)
+        v = v.reshape(S, K, self.n_heads, Dh)
+        rows = pos[:, None] + jnp.arange(K, dtype=pos.dtype)[None]  # [S, K]
+        sl = jnp.arange(S)[:, None]
+        ck = ck.at[sl, rows].set(k)
+        cv = cv.at[sl, rows].set(v)
+        scores = jnp.einsum("skhd,sthd->shkt", q, ck) / jnp.asarray(
             Dh ** 0.5, self.dtype)
-        live = (jnp.arange(T)[None, :] <= pos[:, None])     # [S, T]
+        live = (jnp.arange(T)[None, None, :] <= rows[:, :, None])  # [S,K,T]
         scores = jnp.where(live[:, None], scores,
                            jnp.asarray(-1e9, scores.dtype))
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         probs = probs.astype(self.dtype)
-        att = jnp.einsum("sht,sthd->shd", probs, cv).reshape(S, -1)
+        att = jnp.einsum("shkt,sthd->skhd", probs, cv).reshape(S, K, -1)
         x = x + self.attn_out(att)
         return self._mlp(x), ck, cv
+
 
 
 class ServingLM(nn.Module):
@@ -190,27 +216,33 @@ class ServingLM(nn.Module):
         self.ln_f = nn.LayerNorm(dtype=self.dtype, name="ln_f")
 
     def prefill(self, tokens):
-        """tokens [1, P] -> (logits [1, P, V] f32,
-        k [L, P, H, Dh], v [L, P, H, Dh])."""
+        """tokens [B, P] -> (logits [B, P, V] f32,
+        k [L, B, P, H, Dh], v [L, B, P, H, Dh]).  Batched: B queued
+        prompts padded into one bucket share one forward, so admission
+        under burst pays one dispatch instead of B (each prompt's math
+        is batch-independent — same rows, same results)."""
         P = tokens.shape[1]
         x = self.embed(tokens)
         x = x + self.pos(jnp.arange(P, dtype=jnp.int32))[None]
         ks, vs = [], []
         for blk in self.blocks:
             x, k, v = blk.prefill(x)
-            ks.append(k[0])
-            vs.append(v[0])
+            ks.append(k)
+            vs.append(v)
         x = self.ln_f(x)
         logits = self.embed.attend(x).astype(jnp.float32)
         return logits, jnp.stack(ks), jnp.stack(vs)
 
-    def decode(self, tok, positions, ck, cv):
-        """tok [S], positions [S], caches [L, S, T, H, Dh] ->
-        (logits [S, V] f32, ck, cv)."""
-        x = self.embed(tok) + self.pos(positions)
+    def verify(self, toks, positions, ck, cv):
+        """toks [S, K], positions [S], caches [L, S, T, H, Dh] ->
+        (logits [S, K, V] f32, ck, cv) — the speculative-verify /
+        suffix-extend program (see ServingBlock.verify)."""
+        K = toks.shape[1]
+        x = self.embed(toks) + self.pos(
+            positions[:, None] + jnp.arange(K, dtype=jnp.int32)[None])
         new_k, new_v = [], []
         for i, blk in enumerate(self.blocks):
-            x, k_i, v_i = blk.decode(x, ck[i], cv[i], positions)
+            x, k_i, v_i = blk.verify(x, ck[i], cv[i], positions)
             new_k.append(k_i)
             new_v.append(v_i)
         ck = jnp.stack(new_k)
@@ -218,6 +250,15 @@ class ServingLM(nn.Module):
         x = self.ln_f(x)
         logits = self.embed.attend(x).astype(jnp.float32)
         return logits, ck, cv
+
+    def decode(self, tok, positions, ck, cv):
+        """tok [S], positions [S], caches [L, S, T, H, Dh] ->
+        (logits [S, V] f32, ck, cv) — the K == 1 window of
+        :meth:`verify`, NOT a separate program (see ServingBlock: two
+        token-step programs can flip a near-tied argmax between them,
+        which breaks the speculative path's bitwise-greedy oracle)."""
+        logits, ck, cv = self.verify(tok[:, None], positions, ck, cv)
+        return logits[:, 0], ck, cv
 
 
 def serving_lm_for(model: TransformerLM) -> ServingLM:
@@ -242,6 +283,71 @@ def _prefill_buckets(cache_len: int, smallest: int = 8) -> tuple:
         b *= 2
     out.append(cache_len)
     return tuple(out)
+
+
+# --- the compiled programs (module-level: ONE jit cache per process) ------
+# jax.jit keys its compile cache on (function identity, static args,
+# shapes).  Built as closures inside ``DecodeEngine.__init__`` these were
+# per-INSTANCE jit objects, so a second engine of identical geometry
+# recompiled every program the first had already paid for (~3 s per
+# engine on one CPU core) — and fresh engines are routine: a spec DRAFT
+# engine next to its target, a promoted replica, every test.  The
+# ServingLM module passes STATICALLY (flax modules hash by config), so
+# equal-config engines share programs process-wide; donation stays on
+# the cache operands only.
+
+def _decode_step_fn(smodel, params, ck, cv, tok, pos):
+    logits, ck, cv = smodel.apply({"params": params}, tok, pos, ck, cv,
+                                  method=ServingLM.decode)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+
+_decode_step = jax.jit(_decode_step_fn, static_argnums=0,
+                       donate_argnums=(2, 3))
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(2, 3))
+def _decode_logits_step(smodel, params, ck, cv, tok, pos):
+    # The sampling seam: same decode program, f32 logits out instead of
+    # the fused argmax (greedy keeps its own program — and its pinned
+    # HLO contract — untouched).
+    return smodel.apply({"params": params}, tok, pos, ck, cv,
+                        method=ServingLM.decode)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(2, 3))
+def _verify_window(smodel, params, ck, cv, toks, pos):
+    logits, ck, cv = smodel.apply({"params": params}, toks, pos, ck, cv,
+                                  method=ServingLM.verify)
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            logits, ck, cv)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(2, 3))
+def _prefill_bucketed(smodel, params, ck, cv, toks, slots_ix, lengths):
+    # toks [B, Pb] — B queued prompts in one bucketed forward;
+    # slots_ix/lengths [B].  Each prompt's K/V rows scatter into its own
+    # slot; the "first generated token" is the argmax at each prompt's
+    # true last position (pad rows beyond it are never read).
+    logits, k, v = smodel.apply({"params": params}, toks,
+                                method=ServingLM.prefill)
+    ck = ck.at[:, slots_ix, :toks.shape[1]].set(k)
+    cv = cv.at[:, slots_ix, :toks.shape[1]].set(v)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return (jnp.argmax(last, axis=-1).astype(jnp.int32), last, ck, cv)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _splice_rows(ck, cv, k_rows, v_rows, slot):
+    # Prefix-cache import: splice stored [L, W, H, Dh] rows into one
+    # slot (rows beyond the real prefix are stale bucket padding —
+    # masked until overwritten, like prefill's own).
+    ck = jax.lax.dynamic_update_slice(ck, k_rows[:, None],
+                                      (0, slot, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v_rows[:, None],
+                                      (0, slot, 0, 0, 0))
+    return ck, cv
 
 
 class DecodeEngine:
@@ -288,37 +394,18 @@ class DecodeEngine:
         self.last_tokens = np.zeros((self.slots,), np.int32)
         self.decode_steps = 0
         self.prefills = 0
-        # Which prefill buckets have compiled: the first call per
-        # bucket pays the jit compile, and callers timing prefill for
-        # an admission predictor must know to exclude it.
+        # Which (bucket, batch) prefill shapes have compiled: the first
+        # call per shape pays the jit compile, and callers timing
+        # prefill for an admission predictor must know to exclude it.
         self._warm_buckets: set = set()
         self.last_prefill_was_cold = False
 
-        smodel = self.smodel
-
-        def _decode(params, ck, cv, tok, pos):
-            logits, ck, cv = smodel.apply({"params": params}, tok, pos,
-                                          ck, cv,
-                                          method=ServingLM.decode)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
-
-        def _prefill(params, ck, cv, toks, slot, length):
-            logits, k, v = smodel.apply({"params": params}, toks,
-                                        method=ServingLM.prefill)
-            ck = jax.lax.dynamic_update_slice(ck, k[:, None],
-                                              (0, slot, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v[:, None],
-                                              (0, slot, 0, 0, 0))
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                                axis=0, keepdims=False)
-            return jnp.argmax(last).astype(jnp.int32), ck, cv
-
-        self._decode_fn = _decode
-        self._decode_jit = jax.jit(_decode, donate_argnums=(1, 2))
-        # One jit object; the per-bucket programs are its shape-keyed
-        # cache entries (slot + length stay traced scalars so slot
-        # choice never recompiles).
-        self._prefill_jit = jax.jit(_prefill, donate_argnums=(1, 2))
+        # The compiled programs live at module level (shared jit cache
+        # across engines — see the block above _decode_step); this
+        # UNJITTED binding exists for callers that need a fresh
+        # variant lowering of the decode step (the HLO contract's
+        # donation-teeth test compiles it WITHOUT donation).
+        self._decode_fn = functools.partial(_decode_step_fn, self.smodel)
 
     # --- the two steps ----------------------------------------------------
     def bucket_for(self, prompt_len: int, max_new: int) -> int:
@@ -343,20 +430,48 @@ class DecodeEngine:
         first generated token.  Pads to the chosen bucket with token 0 —
         pad rows land in the cache beyond the slot's frontier, where the
         decode mask excludes them until a real token overwrites each."""
-        prompt = np.asarray(prompt, np.int32).ravel()
-        P = len(prompt)
-        bucket = self.bucket_for(P, max_new)
-        self.last_prefill_was_cold = bucket not in self._warm_buckets
-        self._warm_buckets.add(bucket)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :P] = prompt
-        tok, self._ck, self._cv = self._prefill_jit(
-            self.params, self._ck, self._cv, jnp.asarray(padded),
-            np.int32(slot), np.int32(P))
-        self.positions[slot] = P
-        self.last_tokens[slot] = int(tok)
-        self.prefills += 1
-        return int(tok)
+        (tok, _), = self.prefill_many([(slot, prompt, max_new)]).values()
+        return tok
+
+    def prefill_many(self, assignments: list) -> dict:
+        """Batched prefill: ``assignments`` is [(slot, prompt, max_new),
+        ...]; prompts sharing a padding bucket share ONE forward (the
+        burst-amortization rung: B admissions cost one dispatch per
+        bucket, not B).  Returns {slot: (first_token, last_logits)} —
+        the f32 logits at each prompt's last position, for callers that
+        sample the first token instead of taking the fused argmax.
+        ``last_prefill_was_cold`` reports whether ANY group compiled."""
+        groups: dict = {}
+        for slot, prompt, max_new in assignments:
+            prompt = np.asarray(prompt, np.int32).ravel()
+            bucket = self.bucket_for(len(prompt), max_new)
+            groups.setdefault(bucket, []).append((slot, prompt))
+        out: dict = {}
+        cold = False
+        for bucket, group in sorted(groups.items()):
+            B = len(group)
+            if (bucket, B) not in self._warm_buckets:
+                cold = True
+            self._warm_buckets.add((bucket, B))
+            padded = np.zeros((B, bucket), np.int32)
+            slots_ix = np.zeros((B,), np.int32)
+            lengths = np.zeros((B,), np.int32)
+            for i, (slot, prompt) in enumerate(group):
+                padded[i, :len(prompt)] = prompt
+                slots_ix[i] = slot
+                lengths[i] = len(prompt)
+            toks, last, self._ck, self._cv = _prefill_bucketed(
+                self.smodel, self.params, self._ck, self._cv,
+                jnp.asarray(padded), slots_ix, lengths)
+            toks = np.asarray(toks)
+            last = np.asarray(last)
+            for i, (slot, prompt) in enumerate(group):
+                self.positions[slot] = len(prompt)
+                self.last_tokens[slot] = int(toks[i])
+                out[slot] = (int(toks[i]), last[i])
+            self.prefills += B
+        self.last_prefill_was_cold = cold
+        return out
 
     def decode(self, busy=None) -> np.ndarray:
         """One decode step over ALL slots (idle slots compute too — the
@@ -366,9 +481,9 @@ class DecodeEngine:
         slots' frontiers (``busy=None`` advances all): an idle slot's
         parked frontier must not drift toward the cache/positional-
         table edge one row per step of everyone else's work."""
-        toks, self._ck, self._cv = self._decode_jit(
-            self.params, self._ck, self._cv, self.last_tokens,
-            self.positions)
+        toks, self._ck, self._cv = _decode_step(
+            self.smodel, self.params, self._ck, self._cv,
+            self.last_tokens, self.positions)
         out = np.asarray(toks)
         advance = (np.ones(self.slots, bool) if busy is None
                    else np.zeros(self.slots, bool))
@@ -379,6 +494,73 @@ class DecodeEngine:
         self.positions = self.positions + advance.astype(np.int32)
         self.decode_steps += 1
         return out
+
+    def decode_logits(self, busy=None) -> np.ndarray:
+        """One decode step returning the f32 logits [S, V] instead of
+        the fused argmax — the sampling path.  Advances the busy slots'
+        frontiers like :meth:`decode`, but the caller OWNS each busy
+        slot's next token: it must ``set_slot(slot, token,
+        positions[slot])`` before the next step (greedy's fused-argmax
+        program, and its HLO contract, are untouched by this seam)."""
+        logits, self._ck, self._cv = _decode_logits_step(
+            self.smodel, self.params, self._ck, self._cv,
+            self.last_tokens, self.positions)
+        out = np.asarray(logits)
+        advance = (np.ones(self.slots, bool) if busy is None
+                   else np.zeros(self.slots, bool))
+        if busy is not None:
+            advance[list(busy)] = True
+        self.positions = self.positions + advance.astype(np.int32)
+        self.decode_steps += 1
+        return out
+
+    def verify_step(self, toks, positions) -> tuple:
+        """One batched K-token verify over all slots: toks [S, K],
+        positions [S] (a slot not participating passes position ==
+        cache_len — its scatters drop out of bounds and its output rows
+        are garbage to discard).  Returns (greedy [S, K] int32,
+        logits [S, K, V] f32).  Advances NOTHING — the caller owns
+        accept/rollback bookkeeping via :meth:`set_slot`."""
+        g, logits, self._ck, self._cv = _verify_window(
+            self.smodel, self.params, self._ck, self._cv,
+            jnp.asarray(np.asarray(toks, np.int32)),
+            jnp.asarray(np.asarray(positions, np.int32)))
+        self.decode_steps += 1
+        return np.asarray(g), np.asarray(logits)
+
+    def extend(self, slot: int, tokens, start: int) -> tuple:
+        """Append already-known ``tokens`` to ``slot``'s cache at rows
+        ``start..`` (the prefix-cache suffix path) via the verify
+        program, padded to a power-of-two window.  Returns
+        (next_token, last_logits) at the final appended position."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty extension")
+        K = 1
+        while K < n:
+            K *= 2
+        toks = np.zeros((self.slots, K), np.int32)
+        pos = np.full((self.slots,), self.cache_len, np.int32)
+        toks[slot, :n] = tokens
+        pos[slot] = int(start)
+        g, logits = self.verify_step(toks, pos)
+        return int(g[slot, n - 1]), logits[slot, n - 1]
+
+    def read_rows(self, slot: int, width: int) -> tuple:
+        """Export ``slot``'s first ``width`` K/V rows as independent
+        device arrays [L, width, H, Dh] (the prefix-cache registration
+        read).  Blocked to completion so the copies cannot race the
+        next step's cache donation."""
+        k = self._ck[:, slot, :width]
+        v = self._cv[:, slot, :width]
+        return jax.block_until_ready(k), jax.block_until_ready(v)
+
+    def write_rows(self, slot: int, k_rows, v_rows) -> None:
+        """Import stored K/V rows into ``slot`` (the prefix-cache hit
+        write); the caller then ``set_slot``s the real prefix length."""
+        self._ck, self._cv = _splice_rows(
+            self._ck, self._cv, k_rows, v_rows, np.int32(slot))
 
     def set_slot(self, slot: int, last_token: int, position: int) -> None:
         """Host bookkeeping hook (the batcher parks retired slots at
@@ -392,8 +574,7 @@ class DecodeEngine:
         front checks :data:`DECODE_HLO_CONTRACT` against.  Compiled
         from the UNDONATED argument values via a separate lowering (the
         live step's buffers must not be consumed by a lint pass)."""
-        lowered = jax.jit(self._decode_fn,
-                          donate_argnums=(1, 2)).lower(
-            self.params, self._ck, self._cv, self.last_tokens,
-            self.positions)
+        lowered = _decode_step.lower(
+            self.smodel, self.params, self._ck, self._cv,
+            self.last_tokens, self.positions)
         return lowered.compile().as_text()

@@ -163,6 +163,109 @@ def promote(snapshot_dir: str, size: str, *, step: int | None = None,
                          layout=layout, manifest=man)
 
 
+@dataclasses.dataclass
+class ShardedPromotion:
+    """What sharded promotion hands the row-resident engine: the
+    bucket rows at 1/D per device plus the layout that explains them —
+    the full tree is NEVER a member (that absence is the point)."""
+    model: object               # the training TransformerLM (arch facts)
+    rows: tuple                 # per-bucket [D*W_b] rows, 1/D resident
+    layout: object              # the Zero3Layout (plan, mesh, treedef)
+    step: int                   # snapshot step served
+    source_layout: str          # update_layout the snapshot was written in
+    manifest: dict              # the winning snapshot's manifest
+
+
+def promote_sharded(snapshot_dir: str, size: str, *,
+                    step: int | None = None, tx=None,
+                    sample_len: int = 8, mesh_size: int | None = None,
+                    bucket_bytes: int | None = None) -> ShardedPromotion:
+    """Promotion that keeps params SHARDED: the serving twin of
+    :func:`promote` for the params-stay-sharded engine
+    (serving/sharded.py).  A ``zero3_rows`` snapshot restores into its
+    row template and the rows are handed over AS IS — no
+    ``Zero3Layout.materialize``, so the full tree is never resident in
+    the worker, which is what the measured-1/D acceptance criterion
+    means.  A ``tree``/``bucket_rows`` snapshot starts replicated by
+    format; its params convert DOWN through ``Zero3Layout.init_rows``
+    (which donates — the replicated copy stops existing the moment the
+    layout does).
+
+    ``mesh_size`` for a ``zero3_rows`` snapshot is the manifest's (rows
+    are a function of D; asking for a different one is refused by
+    name).  For replicated formats it defaults to the manifest's
+    recorded mesh, else every visible device."""
+    import jax
+
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.bucketing import (
+        DEFAULT_BUCKET_BYTES)
+    from distributedtensorflowexample_tpu.parallel.zero3 import (
+        Zero3Layout)
+
+    store = SnapshotStore(snapshot_dir)
+    if step is None:
+        step = store.latest_valid()
+    if step is None:
+        raise ValueError(
+            f"no valid snapshot in {snapshot_dir!r} — nothing to "
+            f"promote (run training, or serve_lm's init_if_missing "
+            f"mode for a demo-grade init)")
+    man = store.manifest(step) or {}
+    meta = man.get("meta") or {}
+    snap_model = meta.get("model")
+    if snap_model and snap_model != size:
+        raise ModeRefusal(
+            f"snapshot {step} in {snapshot_dir} was written by model "
+            f"{snap_model!r}; this worker was asked to serve --size "
+            f"{size!r} — refusing to bind across architectures")
+    layout_name = meta.get("update_layout", "tree")
+    if layout_name not in _LAYOUTS:
+        raise ValueError(f"snapshot {step} declares unknown "
+                         f"update_layout {layout_name!r} "
+                         f"(one of {_LAYOUTS})")
+    model = build_model(size)
+    if layout_name == "zero3_rows":
+        snap_mesh = int(meta.get("mesh_size") or 0)
+        if mesh_size is not None and int(mesh_size) != snap_mesh:
+            raise ModeRefusal(
+                f"snapshot {step} holds zero3_rows written at mesh_size "
+                f"{snap_mesh} but --sharded_mesh {mesh_size} was "
+                f"requested — the row layout is a function of D; "
+                f"re-shard through a training-side conversion, or serve "
+                f"at the snapshot's mesh size")
+        template, z3 = _template(model, tx or _default_tx(), layout_name,
+                                 meta, sample_len)
+        state = store.restore(template, step=step)
+        _log(f"promoted snapshot step {step} (zero3_rows, rows kept "
+             f"sharded at 1/{z3.num_devices}) from {snapshot_dir}")
+        return ShardedPromotion(model=model, rows=tuple(state.params),
+                                layout=z3, step=int(step),
+                                source_layout=layout_name, manifest=man)
+    # Replicated-by-format snapshot: restore full, convert DOWN.
+    template, _ = _template(model, tx or _default_tx(), layout_name,
+                            meta, sample_len)
+    state = store.restore(template, step=step)
+    D = int(mesh_size or meta.get("mesh_size") or len(jax.devices()))
+    if D > len(jax.devices()):
+        raise ModeRefusal(
+            f"--sharded_mesh {D} exceeds the {len(jax.devices())} "
+            f"visible device(s) — the row layout shards one row per "
+            f"device")
+    bb = int(bucket_bytes or meta.get("bucket_bytes")
+             or DEFAULT_BUCKET_BYTES)
+    mesh = make_mesh(D)
+    repl = jax.device_put(state.params, replicated_sharding(mesh))
+    z3 = Zero3Layout(repl, bb, mesh)
+    rows = z3.init_rows(repl)       # donates: the full copy dies here
+    _log(f"promoted snapshot step {step} ({layout_name} → zero3 rows "
+         f"at 1/{D}, bucket_bytes {bb}) from {snapshot_dir}")
+    return ShardedPromotion(model=model, rows=tuple(rows), layout=z3,
+                            step=int(step), source_layout=layout_name,
+                            manifest=man)
+
+
 def init_lm_snapshot(snapshot_dir: str, size: str, seed: int = 0,
                      sample_len: int = 8) -> int:
     """Write a demo-grade snapshot: a seeded, untrained graft-LM state

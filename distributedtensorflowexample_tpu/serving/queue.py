@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.obs import trace as obs_trace
 from distributedtensorflowexample_tpu.refusal import ModeRefusal
 from distributedtensorflowexample_tpu.serving.engine import DecodeEngine
 from distributedtensorflowexample_tpu.serving.promote import as_prompt
@@ -218,13 +219,31 @@ class ContinuousBatcher:
 
     def __init__(self, engine: DecodeEngine, queue: RequestQueue, *,
                  slo_ms: float | None = None, eos_id: int | None = None,
-                 on_step=None):
+                 on_step=None, spec=None, sampler=None,
+                 prefix_cache=None):
+        if spec is not None and sampler is not None:
+            raise ModeRefusal(
+                "--sample_temp/--sample_top_k cannot combine with "
+                "--spec_draft: speculative acceptance compares "
+                "bitwise-GREEDY tokens against the draft (the oracle "
+                "contract), and a sampled token has no greedy oracle — "
+                "run one or the other")
+        if sampler is not None and not hasattr(engine, "decode_logits"):
+            raise ModeRefusal(
+                "--sample_temp/--sample_top_k need the engine's "
+                "logits-returning decode seam, which the "
+                "params-stay-sharded engine (--sharded_mesh) does not "
+                "expose — sampling composes with the replicated path "
+                "only")
         self.engine = engine
         self.queue = queue
         self.slo_ms = serve_slo_ms_default() if slo_ms is None \
             else float(slo_ms)
         self.eos_id = eos_id
         self.on_step = on_step          # per-boundary callback (heartbeat)
+        self.spec = spec                # SpecDecoder (serving/spec.py)
+        self.sampler = sampler          # Sampler (serving/sampling.py)
+        self.prefix_cache = prefix_cache  # PrefixCache (serving/prefix.py)
         self._slots = [_Slot() for _ in range(engine.slots)]
         # Step-time EWMA feeding the admission predictor; seeded on the
         # first measured step (the compile step is excluded — it would
@@ -258,8 +277,11 @@ class ContinuousBatcher:
     def _admit(self, now: float) -> None:
         """Fill open slots from the queue head; SLO-reject requests
         that can no longer finish in time (they would only burn slot
-        capacity to miss)."""
+        capacity to miss).  Admissions passing the gates are collected
+        and prefilled as ONE batch per padding bucket
+        (``engine.prefill_many`` — the burst-amortization rung)."""
         free = self._free_slots()
+        batch: list = []
         while free and len(self.queue):
             req = self.queue.pop()
             if req is None:
@@ -283,29 +305,75 @@ class ContinuousBatcher:
                 _REQUESTS.labels(outcome="slo_rejected").inc()
                 self.rejected.append(req)
                 continue
-            slot = free.pop(0)
-            t0 = time.monotonic()
-            first = self.engine.prefill(slot, req.prompt, req.max_new)
+            batch.append((free.pop(0), req))
+        if batch:
+            self._prefill_batch(batch)
+        _SLOTS_BUSY.set(self.engine.slots - len(self._free_slots()))
+
+    def _prefill_batch(self, batch: list) -> None:
+        """Admit ``batch`` = [(slot, req), ...]: prefix-cache probes
+        first (a hit skips the forward entirely), the remaining misses
+        in one bucketed ``prefill_many`` call, then per-request
+        bookkeeping (first token — sampled when a sampler is armed —
+        tracing spans, draft-engine prefill for speculation)."""
+        served: dict = {}                 # slot -> (first, logits, outcome)
+        todo: list = []
+        for slot, req in batch:
+            hit = None if self.prefix_cache is None \
+                else self.prefix_cache.admit(slot, req.prompt)
+            if hit is not None:
+                served[slot] = hit
+            else:
+                todo.append((slot, req))
+        t0 = time.monotonic()
+        if todo:
+            out = self.engine.prefill_many(
+                [(slot, req.prompt, req.max_new) for slot, req in todo])
             dt = time.monotonic() - t0
-            # The first prefill per bucket pays the compile — a wall
-            # time ~1000x steady state that must never seed the
-            # admission predictor (a compile-poisoned EWMA under an
-            # SLO rejects everything, and with nothing admitted it
-            # never decays back: a livelock).
+            # The first prefill per (bucket, batch) shape pays the
+            # compile — a wall time ~1000x steady state that must never
+            # seed the admission predictor (a compile-poisoned EWMA
+            # under an SLO rejects everything, and with nothing
+            # admitted it never decays back: a livelock).  The EWMA
+            # tracks PER-REQUEST cost, so batched admissions make the
+            # predictor cheaper, as measured.
             if not self.engine.last_prefill_was_cold:
-                self._prefill_ewma_s = dt \
+                per = dt / len(todo)
+                self._prefill_ewma_s = per \
                     if self._prefill_ewma_s is None \
-                    else 0.8 * self._prefill_ewma_s + 0.2 * dt
-            _PREFILLS.labels(
-                bucket=self.engine.bucket_for(len(req.prompt),
-                                              req.max_new)).inc()
+                    else 0.8 * self._prefill_ewma_s + 0.2 * per
+            for slot, req in todo:
+                first, last = out[slot]
+                served[slot] = (first, last, "prefill")
+                if self.prefix_cache is not None:
+                    self.prefix_cache.register(slot, req.prompt, first,
+                                               last)
+                _PREFILLS.labels(
+                    bucket=self.engine.bucket_for(len(req.prompt),
+                                                  req.max_new)).inc()
+        prefill_dt = time.monotonic() - t0
+        for slot, req in batch:
+            first, last, outcome = served[slot]
+            if self.sampler is not None:
+                # Even the first token is sampled (index 0 of the
+                # request's RNG lane) — the prefill seam hands back the
+                # last position's logits for exactly this.
+                first = self.sampler.sample(req.rid, 0, last)
+                self.engine.set_slot(slot, first,
+                                     int(self.engine.positions[slot]))
             req.admit_t = req.first_token_t = time.monotonic()
+            obs_trace.event("serve_queue", req.admit_t - req.submit_t,
+                            t0_s=req.submit_t, rid=req.rid, slot=slot)
+            obs_trace.event("serve_prefill", prefill_dt, t0_s=t0,
+                            rid=req.rid, slot=slot, outcome=outcome,
+                            batch=len(todo))
             req.tokens.append(int(first))
             self._slots[slot].req = req
             self.admitted_total += 1
+            if self.spec is not None:
+                self.spec.on_admit(slot, req.prompt, req.max_new)
             # max_new == 1 finishes on the prefill's own token.
             self._maybe_retire(slot, time.monotonic())
-        _SLOTS_BUSY.set(self.engine.slots - len(self._free_slots()))
 
     def _maybe_retire(self, slot: int, now: float) -> bool:
         req = self._slots[slot].req
@@ -320,12 +388,18 @@ class ContinuousBatcher:
         _REQUESTS.labels(outcome="ok").inc()
         _TOKENS.inc(len(req.tokens))
         _LATENCY.observe(req.latency_s)
+        t0 = req.first_token_t if req.first_token_t is not None else now
+        obs_trace.event("serve_decode", now - t0, t0_s=t0, rid=req.rid,
+                        slot=slot, tokens=len(req.tokens),
+                        outcome=req.outcome)
         self.completed.append(req)
         self._slots[slot].req = None
         # Park the freed slot's frontier at 0: idle slots still compute
         # every step, and an unbounded frontier would walk past the
         # positional table for nothing.
         self.engine.set_slot(slot, 0, 0)
+        if self.spec is not None:
+            self.spec.park(slot)
         if len(self.completed) % 32 == 0 or len(self.completed) < 8:
             tape = sorted(r.latency_s for r in self.completed)
             _P50.set(round(percentile(tape, 0.50) * 1000.0, 3))
@@ -336,38 +410,83 @@ class ContinuousBatcher:
     def _busy(self) -> list:
         return [i for i, s in enumerate(self._slots) if s.req is not None]
 
-    def step(self) -> int:
-        """One boundary: admit into open slots, one decode step over
-        the batch, retire finished requests.  Returns the number of
-        live slots decoded (0 = idle boundary)."""
-        now = time.monotonic()
-        self._admit(now)
-        busy = self._busy()
-        if not busy:
-            return 0
-        t0 = time.monotonic()
-        toks = self.engine.decode(busy=busy)
-        dt = time.monotonic() - t0
+    def _note_step_time(self, dt: float) -> None:
         # The engine's FIRST decode step pays the compile — never let
         # it seed the admission predictor (see the prefill comment:
         # a compile-poisoned EWMA under an SLO is a reject-everything
         # livelock, because nothing admitted means nothing ever decays
         # it).  Once seeded, a 50x outlier (a recompile) is skipped.
+        # Under speculation dt is a whole round (>= 1 emitted token per
+        # slot), so max_new x EWMA stays a conservative upper bound.
         if self.engine.decode_steps > 1:
             if self._step_ewma_s is None:
                 self._step_ewma_s = dt
             elif dt < 50 * self._step_ewma_s:
                 self._step_ewma_s = 0.8 * self._step_ewma_s + 0.2 * dt
-        _STEPS.inc()
-        now = time.monotonic()
-        for slot in busy:
-            req = self._slots[slot].req
-            req.tokens.append(int(toks[slot]))
-            self._maybe_retire(slot, now)
+
+    def _decode_once(self) -> int:
+        """One decode boundary over the busy slots, dispatched by mode:
+        a speculative round (draft k, verify once, emit 1..k+1 tokens
+        per slot), a sampled step (logits out, host draws each token on
+        its request's RNG lane), or the default greedy fused-argmax
+        step.  Retires whatever finished.  Returns live slots decoded."""
+        busy = self._busy()
+        if not busy:
+            return 0
+        t0 = time.monotonic()
+        if self.spec is not None:
+            remaining = {
+                s: self._slots[s].req.max_new - len(self._slots[s].req.tokens)
+                for s in busy}
+            emitted = self.spec.round(busy, remaining)
+            self._note_step_time(time.monotonic() - t0)
+            _STEPS.inc()
+            now = time.monotonic()
+            for slot in busy:
+                toks = emitted[slot]
+                if self.eos_id is not None and self.eos_id in toks:
+                    # Plain greedy stops AT eos; a round must not hand
+                    # the request tokens greedy would never have
+                    # produced (the oracle contract).
+                    toks = toks[:toks.index(self.eos_id) + 1]
+                self._slots[slot].req.tokens.extend(toks)
+                self._maybe_retire(slot, now)
+        elif self.sampler is not None:
+            logits = self.engine.decode_logits(busy=busy)
+            self._note_step_time(time.monotonic() - t0)
+            _STEPS.inc()
+            now = time.monotonic()
+            for slot in busy:
+                req = self._slots[slot].req
+                tok = self.sampler.sample(req.rid, len(req.tokens),
+                                          logits[slot])
+                self.engine.set_slot(slot, tok,
+                                     int(self.engine.positions[slot]))
+                req.tokens.append(tok)
+                self._maybe_retire(slot, now)
+        else:
+            toks = self.engine.decode(busy=busy)
+            self._note_step_time(time.monotonic() - t0)
+            _STEPS.inc()
+            now = time.monotonic()
+            for slot in busy:
+                req = self._slots[slot].req
+                req.tokens.append(int(toks[slot]))
+                self._maybe_retire(slot, now)
         _SLOTS_BUSY.set(self.engine.slots - len(self._free_slots()))
+        return len(busy)
+
+    def step(self) -> int:
+        """One boundary: admit into open slots, one decode boundary
+        over the batch, retire finished requests.  Returns the number
+        of live slots decoded (0 = idle boundary)."""
+        self._admit(time.monotonic())
+        n = self._decode_once()
+        if n == 0:
+            return 0
         if self.on_step is not None:
             self.on_step(self)
-        return len(busy)
+        return n
 
     def run(self, should_stop=lambda: False,
             idle_wait_s: float = 0.02) -> None:
@@ -385,22 +504,28 @@ class ContinuousBatcher:
         every in-flight request to completion, reject the queued tail
         loudly (outcome ``drained`` — re-submittable against the next
         placement, never silently lost)."""
+        t0 = time.monotonic()
+        in_flight = len(self._busy())
         self.queue.close()           # later submits answer 'drained'
         now = time.monotonic()
-        for req in self.queue.drain_pending():
+        tail = self.queue.drain_pending()
+        for req in tail:
             req.finish("drained", now)
             _REQUESTS.labels(outcome="drained").inc()
+            obs_trace.event("serve_drain", now - req.submit_t,
+                            t0_s=req.submit_t, rid=req.rid,
+                            outcome="drained")
             self.rejected.append(req)
+        # In-flight work decodes to completion through the SAME
+        # per-boundary dispatch serving used — an in-flight speculative
+        # batch keeps drafting+verifying mid-drain (its tokens are
+        # greedy's tokens either way), a sampled batch keeps its RNG
+        # lanes.
         while self._busy():
-            busy = self._busy()
-            toks = self.engine.decode(busy=busy)
-            _STEPS.inc()
-            now = time.monotonic()
-            for slot in busy:
-                req = self._slots[slot].req
-                req.tokens.append(int(toks[slot]))
-                self._maybe_retire(slot, now)
+            self._decode_once()
         _SLOTS_BUSY.set(0)
+        obs_trace.event("serve_drain", time.monotonic() - t0, t0_s=t0,
+                        in_flight=in_flight, tail=len(tail))
 
     # --- stats ------------------------------------------------------------
     def stats(self) -> dict:
@@ -428,4 +553,9 @@ class ContinuousBatcher:
             "slots": self.engine.slots,
             "step_ewma_ms": (round(self._step_ewma_s * 1000.0, 3)
                              if self._step_ewma_s else None),
+            "spec": None if self.spec is None else self.spec.stats(),
+            "sampler": (None if self.sampler is None
+                        else self.sampler.describe()),
+            "prefix_cache": (None if self.prefix_cache is None
+                             else self.prefix_cache.stats()),
         }

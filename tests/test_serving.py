@@ -1,21 +1,24 @@
-"""serving/ — the continuous-batching inference engine (PR 15): decode
-parity with the training forward, mid-decode admission (continuous
-batching, not batch-drain), SLO admission, OOV refusal, snapshot →
-serving promotion edges (torn-newest fallback, row-layout
-materialization), the decode-step HLO contract, and the obs/ import
-direction.
+"""serving/ — the continuous-batching inference engine (PR 15 + 17):
+decode parity with the training forward, mid-decode admission
+(continuous batching, not batch-drain), SLO admission, OOV refusal,
+snapshot → serving promotion edges (torn-newest fallback, row-layout
+materialization, sharded promotion), the decode-step HLO contracts
+(replicated AND params-stay-sharded), speculative decoding's greedy
+oracle, batched prefill, per-request sampling lanes, the prefix cache,
+and the obs/ import direction.
 
-Inline and tier-1-safe: lm_tiny at tiny slot/cache geometry,
-single-device programs only (no collectives — none of the rendezvous
-risk the isolated files carry).  The engine fixture is module-scoped so
-its prefill/decode compiles are paid once.  The end-to-end serve_lm
-drill (real subprocess, eviction, TERM→143) lives in
-tests/test_scheduler.py next to the other control-plane drills.
+Inline and tier-1-safe: lm_tiny at tiny slot/cache geometry.  The
+sharded tests follow tests/test_collectives.py's precedent — shard_map
+collectives over forced host devices run inline.  The engine fixture is
+module-scoped so its prefill/decode compiles are paid once.  The
+end-to-end serve_lm drill (real subprocess, eviction, TERM→143) lives
+in tests/test_scheduler.py next to the other control-plane drills.
 """
 
 import json
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -29,10 +32,15 @@ from distributedtensorflowexample_tpu.serving.engine import (
     DECODE_HLO_CONTRACT, DecodeEngine, serve_slots_default)
 from distributedtensorflowexample_tpu.serving.loadgen import (
     DriveFile, make_prompt)
+from distributedtensorflowexample_tpu.serving.prefix import PrefixCache
 from distributedtensorflowexample_tpu.serving.promote import (
-    init_lm_snapshot, promote)
+    init_lm_snapshot, promote, promote_sharded)
 from distributedtensorflowexample_tpu.serving.queue import (
     ContinuousBatcher, RequestQueue, percentile, serve_slo_ms_default)
+from distributedtensorflowexample_tpu.serving.sampling import Sampler
+from distributedtensorflowexample_tpu.serving.sharded import (
+    SHARDED_DECODE_HLO_CONTRACT, ShardedDecodeEngine)
+from distributedtensorflowexample_tpu.serving.spec import SpecDecoder
 from distributedtensorflowexample_tpu.training.state import TrainState
 
 pytestmark = pytest.mark.serving
@@ -59,9 +67,51 @@ def engine(lm_state):
     return DecodeEngine(model, state.params, slots=3, cache_len=CACHE)
 
 
-def _greedy_reference(model, params, prompt, n):
+@pytest.fixture(scope="module")
+def draft_engine(lm_state):
+    """A draft net that genuinely DISAGREES with the target (same
+    architecture, params halved) — speculative acceptance must survive
+    rejection, not just the self-draft fast path."""
+    model, state = lm_state
+    scaled = jax.tree.map(lambda a: a * 0.5, state.params)
+    return DecodeEngine(model, scaled, slots=3, cache_len=CACHE)
+
+
+@pytest.fixture(scope="module")
+def sharded_engine(lm_state):
+    from distributedtensorflowexample_tpu.parallel import (
+        make_mesh, replicated_sharding)
+    from distributedtensorflowexample_tpu.parallel.zero3 import (
+        Zero3Layout)
+    model, state = lm_state
+    if len(jax.devices()) < 2:
+        pytest.skip("params-stay-sharded decode needs >= 2 devices")
+    mesh = make_mesh(2)
+    # Host round-trip first: init_rows DONATES its input, and a
+    # device_put of already-resident buffers may alias them — donating
+    # an alias would delete the replicated fixture's params.
+    repl = jax.device_put(jax.tree.map(np.asarray, state.params),
+                          replicated_sharding(mesh))
+    layout = Zero3Layout(repl, 16 << 10, mesh)
+    return ShardedDecodeEngine(model, layout.init_rows(repl), layout,
+                               slots=2, cache_len=CACHE)
+
+
+def _greedy_reference(model, params, prompt, n, got=None):
     """Teacher-forced greedy through the TRAINING forward — the truth
-    the engine must reproduce token-for-token."""
+    the engine must reproduce token-for-token.  With ``got`` (the
+    engine's candidate tokens), verification is ONE forward over
+    [prompt + got]: argmax at each position must select the next
+    candidate, which by induction proves ``got`` IS the greedy chain —
+    n eager growing-prefix forwards collapse to one.  Without ``got``
+    it generates the chain the slow sequential way."""
+    if got is not None:
+        assert len(got) == n
+        seq = [int(t) for t in prompt] + [int(t) for t in got]
+        logits = model.apply({"params": params},
+                             jnp.asarray([seq], jnp.int32), train=False)
+        P = len(prompt)
+        return [int(jnp.argmax(logits[0, P - 1 + i])) for i in range(n)]
     seq = list(int(t) for t in prompt)
     out = []
     for _ in range(n):
@@ -91,15 +141,16 @@ def test_decode_matches_training_forward_token_exact(lm_state, engine):
     contribute exactly 0.0 after the f32 exp."""
     model, state = lm_state
     prompt = [5, 9, 17, 3, 88, 120, 7]
-    want = _greedy_reference(model, state.params, prompt, 6)
     got = _engine_greedy(engine, 0, prompt, 6)
-    assert got == want
+    assert got == _greedy_reference(model, state.params, prompt, 6,
+                                    got=got)
     # A second prompt through a DIFFERENT slot, same engine, same truth
     # (slot reuse after retirement is the continuous-batching steady
     # state).
     prompt2 = [200, 1, 42]
-    want2 = _greedy_reference(model, state.params, prompt2, 5)
-    assert _engine_greedy(engine, 2, prompt2, 5) == want2
+    got2 = _engine_greedy(engine, 2, prompt2, 5)
+    assert got2 == _greedy_reference(model, state.params, prompt2, 5,
+                                     got=got2)
 
 
 def test_prefill_bucket_table_and_refusals(engine):
@@ -140,8 +191,8 @@ def test_request_admitted_mid_decode_completes_bitwise(lm_state, engine):
         assert batcher.step() > 0
     assert ra.outcome == "ok" and rb.outcome == "ok"
     assert rb.tokens == solo_b       # bitwise: no contamination from A
-    assert ra.tokens[:6] == _greedy_reference(model, state.params,
-                                              prompt_a, 6)
+    assert ra.tokens[:6] == _greedy_reference(
+        model, state.params, prompt_a, 6, got=ra.tokens[:6])
     assert len(ra.tokens) == 12 and ra.first_token_t <= rb.admit_t
 
 
@@ -353,14 +404,334 @@ def test_decode_hlo_contract_holds_and_catches_violations(engine):
 
 
 def test_serving_suite_is_wired_into_the_hlo_front():
-    """graftlint's HLO front includes the serving decode contract, so
-    `python -m tools.graftlint` gates it like the ZeRO schedules."""
+    """graftlint's HLO front includes BOTH serving decode contracts
+    (replicated 0-collective and sharded exactly-B-gathers), so
+    `python -m tools.graftlint` gates them like the ZeRO schedules."""
     from distributedtensorflowexample_tpu.analysis import hlo_lint
     progs = hlo_lint.serving_suite()
-    assert [p["mode"] for p in progs] == ["serve_decode"]
+    assert [p["mode"] for p in progs] == ["serve_decode",
+                                          "serve_decode_sharded"]
     assert progs[0]["contract"] is DECODE_HLO_CONTRACT
-    fs = hlo_lint.check_contract(progs[0]["hlo"], progs[0]["contract"])
-    assert fs == [], [f.message for f in fs]
+    assert progs[1]["contract"] is SHARDED_DECODE_HLO_CONTRACT
+    assert progs[1]["symbols"]["B"] >= 1
+    for prog in progs:
+        fs = hlo_lint.check_contract(prog["hlo"], prog["contract"],
+                                     symbols=prog["symbols"])
+        assert fs == [], [f.message for f in fs]
+
+
+# ---- params-stay-sharded decode (PR 17 tentpole a) -----------------------
+
+def test_sharded_decode_bitwise_and_resident_at_one_over_d(
+        lm_state, engine, sharded_engine):
+    """The row-resident engine generates token-for-token what the
+    replicated engine generates (both slots live, one per device), and
+    its LIVE params residency is exactly 1/D — the full tree is never
+    materialized."""
+    prompts = ([4, 8, 15, 16, 23], [42, 7])
+    want = [_engine_greedy(engine, 0, prompts[0], 6),
+            _engine_greedy(engine, 1, prompts[1], 6)]
+    got = [[sharded_engine.prefill(s, np.asarray(p, np.int32),
+                                   max_new=6)]
+           for s, p in enumerate(prompts)]
+    for _ in range(5):
+        step = sharded_engine.decode(busy=[0, 1])
+        got[0].append(int(step[0]))
+        got[1].append(int(step[1]))
+    assert got == want
+    res = sharded_engine.params_residency()
+    assert res["num_devices"] == 2
+    assert res["frac_per_device"] == 0.5           # exactly 1/D
+    assert res["params_bytes_per_device"] * 2 == \
+        res["params_bytes_total"]
+
+
+def test_sharded_engine_refuses_bad_geometry_by_name(sharded_engine):
+    with pytest.raises(ModeRefusal, match="--slots"):
+        ShardedDecodeEngine(sharded_engine.model, sharded_engine.rows,
+                            sharded_engine.layout, slots=3,
+                            cache_len=CACHE)       # 3 % 2 != 0
+    with pytest.raises(ModeRefusal, match="--max_len"):
+        ShardedDecodeEngine(sharded_engine.model, sharded_engine.rows,
+                            sharded_engine.layout, slots=2,
+                            cache_len=sharded_engine.model.max_len + 1)
+
+
+def test_sharded_hlo_contract_pins_the_gather_schedule(sharded_engine):
+    """Exactly one all-gather per bucket, pinned: the compiled step
+    passes its own contract, FAILS the replicated path's 0-collective
+    budget (an unbudgeted gather can never slip in silently), and a
+    changed bucket count is a finding in either direction."""
+    from distributedtensorflowexample_tpu.analysis.hlo_lint import (
+        check_contract)
+    hlo = sharded_engine.decode_hlo()
+    B = sharded_engine.layout.num_buckets
+    assert B >= 2                     # the schedule is a real schedule
+    assert check_contract(hlo, SHARDED_DECODE_HLO_CONTRACT,
+                          symbols={"B": B}) == []
+    fs = check_contract(hlo, DECODE_HLO_CONTRACT)
+    assert any(f.rule == "hlo-budget" and "all-gather" in f.message
+               for f in fs), [f.message for f in fs]
+    fs2 = check_contract(hlo, SHARDED_DECODE_HLO_CONTRACT,
+                         symbols={"B": B + 1})
+    assert any(f.rule == "hlo-budget" for f in fs2)
+
+
+def test_promote_sharded_keeps_rows_and_serves_bitwise(tmp_path,
+                                                       lm_state,
+                                                       engine):
+    """Sharded promotion from a TREE snapshot hands back rows (never a
+    materialized tree on the serving path) that decode bitwise what
+    the replicated promotion of the same snapshot decodes."""
+    model, state = lm_state
+    d = str(tmp_path / "snaps")
+    init_lm_snapshot(d, SIZE, seed=0)
+    spm = promote_sharded(d, SIZE, mesh_size=2, bucket_bytes=16 << 10)
+    assert spm.source_layout == "tree"
+    assert spm.layout.num_devices == 2
+    seng = ShardedDecodeEngine(spm.model, spm.rows, spm.layout,
+                               slots=2, cache_len=CACHE)
+    pm = promote(d, SIZE)
+    reng = DecodeEngine(pm.model, pm.params, slots=2, cache_len=CACHE)
+    prompt = [9, 1, 1, 2, 3, 5, 8]
+    want = [reng.prefill(0, np.asarray(prompt, np.int32), max_new=5)]
+    got = [seng.prefill(0, np.asarray(prompt, np.int32), max_new=5)]
+    for _ in range(4):
+        want.append(int(reng.decode(busy=[0])[0]))
+        got.append(int(seng.decode(busy=[0])[0]))
+    assert got == want
+    # a mesh that cannot exist is refused by name, not deadlocked
+    with pytest.raises(ModeRefusal, match="--sharded_mesh"):
+        promote_sharded(d, SIZE, mesh_size=len(jax.devices()) + 1)
+
+
+# ---- speculative decoding (PR 17 tentpole b) -----------------------------
+
+def test_spec_decode_is_bitwise_greedy_incl_mid_decode_admission(
+        engine, draft_engine):
+    """THE speculative acceptance: a disagreeing draft + batched
+    verify emits exactly plain greedy's tokens — including for a
+    request admitted mid-decode into a running speculative batch."""
+    prompt_a, prompt_b = [10, 20, 30, 40, 50], [7, 7, 99]
+    solo_a = _engine_greedy(engine, 0, prompt_a, 9)
+    solo_b = _engine_greedy(engine, 1, prompt_b, 5)
+    queue = RequestQueue(engine.vocab)
+    spec = SpecDecoder(engine, draft_engine, k=3)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=0.0, spec=spec)
+    ra = queue.submit(prompt_a, 9, rid="A")
+    batcher.step()                    # admits A, first spec round
+    assert not ra.done.is_set()       # A is mid-decode
+    rb = queue.submit(prompt_b, 5, rid="B")
+    while not (ra.done.is_set() and rb.done.is_set()):
+        batcher.step()
+    assert ra.outcome == "ok" and rb.outcome == "ok"
+    assert ra.tokens == solo_a        # bitwise the greedy oracle
+    assert rb.tokens == solo_b
+    st = spec.stats()
+    assert st["emitted"] == (9 - 1) + (5 - 1)   # first tokens = prefill
+    assert st["rounds"] >= 2 and st["drafted"] >= 3 * st["rounds"] // 2
+    assert 1.0 <= st["accept_len_mean"] <= 4.0
+
+
+def test_spec_round_truncates_at_eos_like_greedy(engine, draft_engine):
+    """A verify round may emit several tokens at once; an eos inside
+    the window must truncate exactly where plain greedy stops — the
+    round never hands out tokens greedy would not have produced."""
+    prompt = [5, 9, 17, 3]
+    ref = _engine_greedy(engine, 0, prompt, 8)
+    eos = ref[4]
+
+    def run(spec):
+        queue = RequestQueue(engine.vocab)
+        b = ContinuousBatcher(engine, queue, slo_ms=0.0, eos_id=eos,
+                              spec=spec)
+        r = queue.submit(prompt, 8, rid="E")
+        while not r.done.is_set():
+            b.step()
+        return r.tokens
+
+    expected = ref[:ref.index(eos) + 1]
+    assert run(None) == expected
+    assert run(SpecDecoder(engine, draft_engine, k=3)) == expected
+
+
+def test_drain_completes_inflight_speculative_batch(engine,
+                                                    draft_engine):
+    """TERM under speculation: drain keeps drafting+verifying the
+    in-flight batch to completion — outputs stay the greedy oracle's,
+    and both engines' freed slots end parked."""
+    prompts = {0: [3, 1, 4], 1: [2, 7, 1, 8], 2: [6, 6, 6]}
+    solo = {s: _engine_greedy(engine, s, p, 7)
+            for s, p in prompts.items()}
+    queue = RequestQueue(engine.vocab)
+    spec = SpecDecoder(engine, draft_engine, k=3)
+    batcher = ContinuousBatcher(engine, queue, slo_ms=0.0, spec=spec)
+    reqs = [queue.submit(p, 7, rid=f"d{s}")
+            for s, p in sorted(prompts.items())]
+    batcher.step()                    # admit all 3, one round
+    assert not all(r.done.is_set() for r in reqs)
+    batcher.drain()
+    for s, r in enumerate(reqs):
+        assert r.outcome == "ok" and r.tokens == solo[s]
+    assert engine.positions.tolist() == [0] * engine.slots
+    assert draft_engine.positions.tolist() == [0] * engine.slots
+
+
+def test_spec_refusals_by_name(engine, draft_engine, lm_state):
+    model, state = lm_state
+    with pytest.raises(ValueError, match="k 0"):
+        SpecDecoder(engine, draft_engine, k=0)
+    with pytest.raises(ValueError, match="lockstep"):
+        SpecDecoder(engine, DecodeEngine(model, state.params, slots=2,
+                                         cache_len=CACHE), k=2)
+    with pytest.raises(ModeRefusal, match="--spec_draft"):
+        ContinuousBatcher(engine, RequestQueue(engine.vocab),
+                          spec=SpecDecoder(engine, draft_engine, k=2),
+                          sampler=Sampler(seed=0))
+
+
+def test_spec_self_draft_full_acceptance_under_slot_churn(lm_state, engine):
+    """The bench-shaped regression: MANY mixed-bucket requests churning
+    through few slots, self-draft (draft == target params).  Two bugs
+    hid here that the short solo oracles missed: (1) a separate
+    single-query decode program whose bf16 logits could TIE-FLIP an
+    argmax against the verify program's (decode is now the K == 1
+    verify window — one program family), and (2) fully-accepted rounds
+    (e == k+1) leaving one unwritten draft-cache row below the new
+    frontier, collapsing acceptance within a few rounds.  With both
+    fixed, a self-draft must match bitwise AND accept every proposal —
+    acceptance below 100% here means the program family's numerics
+    split again."""
+    model, state = lm_state
+    rng = np.random.default_rng(7)
+    prompts = [(rng.integers(1, engine.vocab, size=int(
+        rng.integers(4, 13))).astype(np.int32), 8) for _ in range(16)]
+
+    def run(spec):
+        queue = RequestQueue(engine.vocab)
+        b = ContinuousBatcher(engine, queue, slo_ms=0.0, spec=spec)
+        reqs = [queue.submit(p, m, rid=f"c{i}")
+                for i, (p, m) in enumerate(prompts)]
+        while any(not r.done.is_set() for r in reqs):
+            b.step()
+        return {r.rid: list(r.tokens) for r in reqs}
+
+    greedy = run(None)
+    # One self-draft engine for both k values: every admission prefills
+    # the slot and parked rows are scatter-before-read, so leftover
+    # state from the k=2 run cannot leak into k=4 — and the engines'
+    # programs are shared process-wide anyway (module-level jit cache).
+    draft = DecodeEngine(model, state.params, slots=engine.slots,
+                         cache_len=CACHE)
+    for k in (2, 4):
+        spec = SpecDecoder(engine, draft, k=k)
+        assert run(spec) == greedy, f"spec k={k} diverged from greedy"
+        st = spec.stats()
+        # Self-draft full acceptance is EXACT arithmetic: each request
+        # needs 7 round tokens (prefill emits the first), so its rounds
+        # emit min(k+1, remaining) until done — k=2: 3+3+1 with two
+        # fully-accepted rounds (min(k, e) = 2, 2, 1 accepted), k=4:
+        # 5+2 with one (4, 2).  Any shortfall = acceptance loss.
+        assert st["emitted"] == 16 * 7
+        per_req_accept = {2: 2 + 2 + 1, 4: 4 + 2}[k]
+        assert st["accepted_draft"] == 16 * per_req_accept
+        assert st["accept_len_mean"] == pytest.approx(
+            {2: 7 / 3, 4: 7 / 2}[k], abs=1e-3)
+
+
+# ---- batched prefill -----------------------------------------------------
+
+def test_batched_prefill_matches_solo(engine):
+    """One bucketed prefill_many over a burst produces per-slot exactly
+    the solo prefill's token and cache (the continuation proves the
+    cache: any cross-slot contamination diverges within a step)."""
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7]]
+    solo = [_engine_greedy(engine, 0, p, 4) for p in prompts]
+    out = engine.prefill_many([(s, np.asarray(p, np.int32), 4)
+                               for s, p in enumerate(prompts)])
+    toks = [[int(out[s][0])] for s in range(3)]
+    for _ in range(3):
+        step = engine.decode(busy=[0, 1, 2])
+        for s in range(3):
+            toks[s].append(int(step[s]))
+    assert toks == solo
+
+
+# ---- sampling lanes ------------------------------------------------------
+
+def test_sampler_lanes_are_deterministic_and_refuse_bad_knobs():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=64).astype(np.float32)
+    s1 = Sampler(temperature=0.8, top_k=5, seed=3)
+    s2 = Sampler(temperature=0.8, top_k=5, seed=3)
+    draws = [s1.sample("r1", i, logits) for i in range(16)]
+    assert draws == [s2.sample("r1", i, logits) for i in range(16)]
+    assert draws != [s1.sample("r2", i, logits) for i in range(16)]
+    assert Sampler(top_k=1, seed=0).sample("x", 0, logits) == \
+        int(np.argmax(logits))        # top-1 degenerates to greedy
+    with pytest.raises(ValueError, match="--sample_temp"):
+        Sampler(temperature=0.0)
+    with pytest.raises(ValueError, match="--sample_top_k"):
+        Sampler(top_k=-1)
+
+
+def test_sampled_serving_is_deterministic_per_request_id(engine):
+    """Same rid + same snapshot + same knobs → same tokens, regardless
+    of admission order or slot placement (replayed runs agree)."""
+    def run():
+        queue = RequestQueue(engine.vocab)
+        b = ContinuousBatcher(engine, queue, slo_ms=0.0,
+                              sampler=Sampler(temperature=0.7,
+                                              top_k=10, seed=5))
+        r = queue.submit([8, 6, 7], 6, rid="fixed")
+        while not r.done.is_set():
+            b.step()
+        return r.tokens
+
+    a, b = run(), run()
+    assert a == b and len(a) == 6
+
+
+def test_sampler_refused_with_sharded_engine_by_name():
+    class _NoLogitsSeam:                 # the sharded engine's shape
+        slots = 2
+    with pytest.raises(ModeRefusal, match="--sharded_mesh"):
+        ContinuousBatcher(_NoLogitsSeam(), RequestQueue(16),
+                          sampler=Sampler(seed=0))
+
+
+# ---- prefix cache --------------------------------------------------------
+
+def test_prefix_cache_full_and_partial_hits_bitwise(engine):
+    """A full hit pays zero forward work, a partial hit pays only the
+    suffix — both continue bitwise the cold path (the engine's masked
+    pad rows make stored rows exact, not approximate)."""
+    head = [11, 22, 33, 44, 55]
+    ext = head + [66, 77]
+    solo_head = _engine_greedy(engine, 0, head, 5)
+    solo_ext = _engine_greedy(engine, 0, ext, 5)
+    pc = PrefixCache(engine, capacity=8)
+
+    def run(prompt, rid):
+        queue = RequestQueue(engine.vocab)
+        b = ContinuousBatcher(engine, queue, slo_ms=0.0,
+                              prefix_cache=pc)
+        r = queue.submit(prompt, 5, rid=rid)
+        while not r.done.is_set():
+            b.step()
+        return r.tokens
+
+    assert run(head, "cold") == solo_head
+    assert pc.stats()["misses"] == 1 and pc.stats()["hits"] == 0
+    assert run(head, "warm") == solo_head            # full hit
+    assert pc.stats()["hits"] == 1
+    assert run(ext, "extended") == solo_ext          # partial hit
+    st = pc.stats()
+    assert st["partial_hits"] == 1
+    assert st["rows_reused"] == 2 * len(head)        # full 5 + partial 5
+    assert st["entries"] == 2                        # head + ext
+    with pytest.raises(ModeRefusal, match="--prefix_cache"):
+        PrefixCache(object(), capacity=4)            # sharded-shaped
 
 
 # ---- knobs, helpers, import direction ------------------------------------

@@ -146,17 +146,20 @@ def _lower_is_better(metric: str) -> bool:
 def check_zero_invariants(records: list[dict],
                           outages: set = frozenset()) -> list[dict]:
     """Must-be-zero metrics: the heal family's ``*_lost`` lines
-    (steps_lost, requests_lost).  A nonzero value is an UNEXPLAINED
-    finding regardless of tolerance or noise — a remediation drill
-    that lost a step is a broken resume protocol, not a slow one.
-    Gated on the NEWEST record per (metric, platform) only, with the
-    same OUTAGE_r<N>.md adjudication the throughput ratchet honors: a
-    historical nonzero that a later round fixed (or a documented
-    degraded window) must not stay red forever."""
+    (steps_lost, requests_lost) and the serving family's
+    ``*_mismatch`` lines (speculative-decode tokens diverging from
+    plain greedy).  A nonzero value is an UNEXPLAINED finding
+    regardless of tolerance or noise — a remediation drill that lost a
+    step is a broken resume protocol, and a spec-decode mismatch is a
+    broken acceptance rule, not a slow one.  Gated on the NEWEST
+    record per (metric, platform) only, with the same OUTAGE_r<N>.md
+    adjudication the throughput ratchet honors: a historical nonzero
+    that a later round fixed (or a documented degraded window) must
+    not stay red forever."""
     series: dict = {}
     for rec in records:
         metric = rec.get("metric", "")
-        if metric.endswith("_lost"):
+        if metric.endswith(("_lost", "_mismatch")):
             series.setdefault((metric, _platform(rec)), []).append(rec)
     findings = []
     for (metric, platform), recs in sorted(series.items()):
@@ -191,7 +194,7 @@ def compare_records(records: list[dict], tolerance: float,
     magnitude, whichever direction that metric worsens in."""
     series: dict = {}
     for rec in records:
-        if rec.get("metric", "").endswith("_lost"):
+        if rec.get("metric", "").endswith(("_lost", "_mismatch")):
             # check_zero_invariants owns the must-be-zero family: here
             # a fixed loss (1 -> 0) would read as a 100% "drop".
             continue
@@ -470,7 +473,7 @@ def main(argv: list[str] | None = None) -> int:
                         "record ratchet scans (the serving and heal "
                         "families regress like any bench family; heal "
                         "*_ms metrics gate lower-is-better and *_lost "
-                        "must stay zero)")
+                        "/ *_mismatch must stay zero)")
     p.add_argument("--baseline", default="",
                    help="BASELINE_SELF.json (default: in records_dir)")
     p.add_argument("--tolerance", type=float, default=0.10,

@@ -75,6 +75,36 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--real", action="store_true",
                    help="serve on the configured backend (default pins "
                         "the CPU platform in-process)")
+    p.add_argument("--sharded_mesh", type=int, default=0,
+                   help="params-stay-sharded decode over a D-device "
+                        "mesh (serving/sharded.py): params stay zero3 "
+                        "bucket rows at 1/D, gathered per block inside "
+                        "the compiled step (0 = replicated engine; on "
+                        "CPU without --real this forces D host devices)")
+    p.add_argument("--spec_draft", default="",
+                   help="speculative decoding: LM_SIZES size that "
+                        "DRAFTS (e.g. lm_tiny); the served model "
+                        "verifies — output stays bitwise greedy")
+    p.add_argument("--spec_draft_snapshot", default="",
+                   help="snapshot dir for the draft model (default: "
+                        "the served --snapshot dir)")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="draft window: tokens drafted per verify round")
+    p.add_argument("--sample_temp", type=float, default=0.0,
+                   help="sampling temperature (0 = greedy decode; "
+                        "sampled tokens draw on per-request RNG lanes, "
+                        "deterministic per request id)")
+    p.add_argument("--sample_top_k", type=int, default=0,
+                   help="restrict sampling to the k most likely tokens "
+                        "(0 = full softmax; arms the sampler even at "
+                        "default temperature)")
+    p.add_argument("--sample_seed", type=int, default=0,
+                   help="worker-level seed the per-request RNG lanes "
+                        "derive from")
+    p.add_argument("--prefix_cache", type=int, default=0,
+                   help="share K/V rows across requests with equal "
+                        "prompt prefixes (value = resident prompt "
+                        "capacity; 0 = off)")
     # The in-process closed-loop drive (demo / drills / bench).
     p.add_argument("--drive", type=int, default=0,
                    help="drive N deterministic requests through the "
@@ -97,6 +127,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--ready_file", default="",
                    help="touch this path once the worker is serving")
     args = p.parse_args(argv)
+
+    if args.sharded_mesh > 1 and not args.real:
+        # The pinned-CPU posture needs a mesh to shard over; forcing
+        # host devices must happen before the first jax import.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.sharded_mesh}").strip()
 
     import jax
 
@@ -148,7 +187,13 @@ def main(argv: list[str] | None = None) -> int:
         "serve_lm", config={"snapshot": snapshot, "size": args.size,
                             "slots": slots, "slo_ms": slo_ms,
                             "max_len": args.max_len, "drive": args.drive,
-                            "seed": args.seed})
+                            "seed": args.seed,
+                            "sharded_mesh": args.sharded_mesh,
+                            "spec_draft": args.spec_draft,
+                            "spec_k": args.spec_k,
+                            "sample_temp": args.sample_temp,
+                            "sample_top_k": args.sample_top_k,
+                            "prefix_cache": args.prefix_cache})
     obs_serve.maybe_start()
     ledger = obs_ledger.get()
 
@@ -161,28 +206,87 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr, flush=True)
 
     t0 = time.monotonic()
-    pm = promote(snapshot, args.size)
-    engine = DecodeEngine(pm.model, pm.params, slots=slots,
-                          cache_len=args.max_len)
-    queue = RequestQueue(engine.vocab)
-    hb_path = os.environ.get("SUPERVISE_HEARTBEAT", "")
+    from distributedtensorflowexample_tpu.refusal import ModeRefusal
+    try:
+        if args.sharded_mesh > 0:
+            from distributedtensorflowexample_tpu.serving.promote import (
+                promote_sharded)
+            from distributedtensorflowexample_tpu.serving.sharded import (
+                ShardedDecodeEngine)
+            pm = promote_sharded(snapshot, args.size,
+                                 mesh_size=args.sharded_mesh)
+            engine = ShardedDecodeEngine(pm.model, pm.rows, pm.layout,
+                                         slots=slots,
+                                         cache_len=args.max_len)
+            snap_layout = pm.source_layout
+            mode_desc = f", sharded D={pm.layout.num_devices} (params " \
+                        f"resident at 1/{pm.layout.num_devices})"
+        else:
+            pm = promote(snapshot, args.size)
+            engine = DecodeEngine(pm.model, pm.params, slots=slots,
+                                  cache_len=args.max_len)
+            snap_layout = pm.layout
+            mode_desc = ""
+        spec = sampler = prefix = None
+        if args.spec_draft:
+            from distributedtensorflowexample_tpu.serving.spec import (
+                SpecDecoder)
+            dsnap = args.spec_draft_snapshot or snapshot
+            if args.init_if_missing and dsnap != snapshot:
+                from distributedtensorflowexample_tpu.resilience. \
+                    snapshot import SnapshotStore
+                if SnapshotStore(dsnap).latest_valid() is None:
+                    init_lm_snapshot(dsnap, args.spec_draft,
+                                     seed=args.seed)
+            dpm = promote(dsnap, args.spec_draft)
+            draft_engine = DecodeEngine(dpm.model, dpm.params,
+                                        slots=slots,
+                                        cache_len=args.max_len)
+            spec = SpecDecoder(engine, draft_engine, k=args.spec_k)
+            mode_desc += (f", spec k={args.spec_k} (draft "
+                          f"{args.spec_draft} step {dpm.step})")
+        if args.sample_temp > 0 or args.sample_top_k > 0:
+            from distributedtensorflowexample_tpu.serving.sampling \
+                import Sampler
+            sampler = Sampler(
+                temperature=(args.sample_temp if args.sample_temp > 0
+                             else 1.0),
+                top_k=args.sample_top_k, seed=args.sample_seed)
+            mode_desc += f", sampler {sampler.describe()}"
+        if args.prefix_cache > 0:
+            from distributedtensorflowexample_tpu.serving.prefix import (
+                PrefixCache)
+            prefix = PrefixCache(engine, capacity=args.prefix_cache)
+            mode_desc += f", prefix cache {args.prefix_cache}"
+        queue = RequestQueue(engine.vocab)
+        hb_path = os.environ.get("SUPERVISE_HEARTBEAT", "")
 
-    def on_step(batcher) -> None:
-        # Heartbeat lives in should_stop below (every loop boundary,
-        # busy AND idle) — not here too: at ~0.2 ms/step a second
-        # touch per decode step would be thousands of redundant
-        # open+utime syscalls a second on the hot loop.
-        if ledger is not None:
-            ledger.sample(step=engine.decode_steps)
+        def on_step(batcher) -> None:
+            # Heartbeat lives in should_stop below (every loop
+            # boundary, busy AND idle) — not here too: at ~0.2 ms/step
+            # a second touch per decode step would be thousands of
+            # redundant open+utime syscalls a second on the hot loop.
+            if ledger is not None:
+                ledger.sample(step=engine.decode_steps)
 
-    batcher = ContinuousBatcher(engine, queue, slo_ms=slo_ms,
-                                on_step=on_step)
+        batcher = ContinuousBatcher(engine, queue, slo_ms=slo_ms,
+                                    on_step=on_step, spec=spec,
+                                    sampler=sampler,
+                                    prefix_cache=prefix)
+    except ModeRefusal as e:
+        # Impossible flag combinations are refused BY NAME before any
+        # request could be admitted into them — exit 2, argparse's own
+        # bad-usage code, so the supervisor never retries a config
+        # that can only refuse again.
+        print(f"serve_lm: refused: {e}", file=sys.stderr, flush=True)
+        obs_ledger.end_global(rc=2, errors={"refused": str(e)})
+        return 2
     front = RequestFront(queue, batcher, port).start() if port else None
     print(f"serve_lm: serving {args.size} snapshot step {pm.step} "
-          f"({pm.layout}) — {slots} slot(s), cache {args.max_len} "
+          f"({snap_layout}) — {slots} slot(s), cache {args.max_len} "
           f"rows/slot ({engine.cache_bytes >> 10} KiB), SLO "
           f"{slo_ms or 'off'} ms, load time "
-          f"{time.monotonic() - t0:.2f}s"
+          f"{time.monotonic() - t0:.2f}s" + mode_desc
           + (f", HTTP :{front.port}" if front else ""),
           file=sys.stderr, flush=True)
     if args.ready_file:
@@ -233,10 +337,12 @@ def main(argv: list[str] | None = None) -> int:
     if front is not None:
         front.stop()
     stats = batcher.stats()
-    stats.update(snapshot_step=pm.step, snapshot_layout=pm.layout,
+    stats.update(snapshot_step=pm.step, snapshot_layout=snap_layout,
                  size=args.size, preempted=preempted,
                  drive=gen_summary or None,
                  platform=jax.default_backend())
+    if hasattr(engine, "params_residency"):
+        stats["params_residency"] = engine.params_residency()
     if args.stats:
         tmp = args.stats + ".tmp"
         with open(tmp, "w") as f:
