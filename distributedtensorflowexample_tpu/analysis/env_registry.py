@@ -150,6 +150,17 @@ ENV_REGISTRY: dict[str, str] = {
         "Default SnapshotStore directory tools/serve_lm.py and "
         "bench_serving.py promote when --snapshot is not passed "
         "(serving/promote.py)."),
+    "SIM_MAX_VIRTUAL_S": (
+        "Hard ceiling on total virtual seconds one sim run may "
+        "advance — a livelocked scenario (eviction ping-pong, a gate "
+        "that never opens) dies loudly at the cap instead of pumping "
+        "the event queue forever (sim/harness.py; default 10x the "
+        "scenario horizon)."),
+    "SIM_TEARDOWN_S": (
+        "Default request_stop -> unanimous-143 teardown latency for "
+        "simulated gangs when the scenario's per-job sim knobs don't "
+        "script one — stretch it to drill slow-drain eviction windows "
+        "(sim/fleet.py; default 1.0)."),
     "SUPERVISE_ATTEMPT": (
         "Attempt number of the supervised child, exported by the "
         "supervisor so obs rows carry retry provenance (obs/*)."),

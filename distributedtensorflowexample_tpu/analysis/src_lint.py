@@ -19,9 +19,12 @@ enforced by runtime probe or reviewer memory:
   :class:`~distributedtensorflowexample_tpu.refusal.ModeRefusal`, so
   the whole refusal surface stays one grep.
 * ``clock-seam`` — no bare ``time.time()``/``time.monotonic()``/
-  ``datetime.now()`` in obs/ outside the ``obs/metrics.py`` seam
-  (``_now``/``_wall``): the bitwise-flight contract says tests pin
-  timestamps by monkeypatching ONE place.
+  ``datetime.now()`` in obs/ — nor in the control plane
+  (``resilience/scheduler.py``, ``resilience/remediate.py``) —
+  outside the ``obs/metrics.py`` seam (``_now``/``_wall``): the
+  bitwise-flight contract says tests pin timestamps by monkeypatching
+  ONE place, and sim/'s virtual clock drives the REAL scheduler +
+  remediator through the same seam.
 * ``keep-in-sync`` — paired ``KEEP-IN-SYNC(<id>) digest=<hex12>`` ...
   ``KEEP-IN-SYNC-END(<id>)`` regions must exist in >= 2 files and all
   carry the digest of the pair's current content, so drift between
@@ -452,11 +455,18 @@ def check_named_refusal(repo_root: str, package: str,
 
 
 # ---------------------------------------------------------------------------
-# Clock-seam rule (obs/ only).
+# Clock-seam rule (obs/ plus the seam-consuming control plane).
 
 _CLOCK_FUNCS = frozenset({"time", "monotonic", "perf_counter",
                           "monotonic_ns", "time_ns"})
 _NOW_FUNCS = frozenset({"now", "utcnow", "today"})
+#: Modules outside obs/ that the sim's virtual clock must fully own —
+#: the scheduler and remediator make every decision through
+#: obs/metrics._now/_wall (their ``_sleep = time.sleep`` module seams
+#: are assignments, not calls, so the rule never flags the seams
+#: themselves).
+_CLOCK_SEAM_EXTRA = frozenset({
+    "resilience.scheduler", "resilience.remediate"})
 
 
 def check_clock_seam(repo_root: str, package: str,
@@ -465,7 +475,11 @@ def check_clock_seam(repo_root: str, package: str,
     mods = mods if mods is not None else _load_package(repo_root, package)
     findings: list[Finding] = []
     for dotted in sorted(mods):
-        if not (dotted == "obs" or dotted.startswith("obs.")):
+        # obs/ plus the control-plane modules sim/'s virtual clock must
+        # fully own: one bare read in a decision path and two same-seed
+        # simulator runs stop being bitwise-identical.
+        if not (dotted == "obs" or dotted.startswith("obs.")
+                or dotted in _CLOCK_SEAM_EXTRA):
             continue
         if dotted == "obs.metrics":     # the seam's home
             continue
@@ -520,9 +534,9 @@ def check_clock_seam(repo_root: str, package: str,
                 findings.append(Finding(
                     "clock-seam", rel, node.lineno,
                     f"clock-seam:{rel}:{dotted_call}:{count}",
-                    f"bare {dotted_call}() in obs/ — go through the "
-                    f"obs/metrics.py seam (_now/_wall) so flight dumps "
-                    f"stay bitwise-pinnable"))
+                    f"bare {dotted_call}() in {dotted} — go through "
+                    f"the obs/metrics.py seam (_now/_wall) so flight "
+                    f"dumps and sim runs stay bitwise-pinnable"))
     return findings
 
 

@@ -32,6 +32,11 @@ regression``          snapshot); the relaunch resumes bitwise from the
                       shape — stop feeding it work
 ``canary_regression`` **canary_rollback** — revert a canary promotion
                       (serving/promote.Canary) to the baseline snapshot
+``serve_overload`` /  **scale_up** / **scale_down** — resize the serve
+``serve_underload``   replica fleet against the measured SLO knee
+                      (SERVE_lm record): offered load over the fleet's
+                      in-SLO capacity grows it, sustained idle shrinks
+                      it, both clamped to [min, max] replicas
 ====================  ====================================================
 
 Every decision is **guarded** — this is the part that makes closing the
@@ -81,6 +86,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob as _glob
+import math
 import os
 import re
 import sys
@@ -95,7 +101,7 @@ from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 # take, written with src="heal" plus a "job" scope field.
 # tools/obs_query.py's `why` verb renders exactly this set — the reader
 # and this writer must not drift.
-# KEEP-IN-SYNC(heal-events) digest=b5297afabbec
+# KEEP-IN-SYNC(heal-events) digest=0b62c0ca8c20
 HEAL_EVENTS = (
     "heal_detect",            # anomaly folded into the policy engine
     "heal_evict",             # loss-free gang stop (TERM→143→resume)
@@ -104,6 +110,8 @@ HEAL_EVENTS = (
     "heal_quarantine",        # repeated offender quarantined (rc-3 shape)
     "heal_canary_promote",    # canary window clean: candidate promoted
     "heal_canary_rollback",   # canary regressed: reverted to baseline
+    "heal_scale_up",          # serve fleet grown against the SLO knee
+    "heal_scale_down",        # serve fleet shrunk (sustained underload)
     "heal_suppressed",        # guardrail suppressed an action (with why)
     "heal_dry_run",           # dry-run: what WOULD have fired
     "heal_budget_exhausted",  # budget gone: detection-only from here on
@@ -112,7 +120,8 @@ HEAL_EVENTS = (
 
 #: Actions (the ``heal_<action>`` applied-row suffixes).
 HEAL_ACTIONS = ("evict", "rollback", "slo_tighten", "quarantine",
-                "canary_promote", "canary_rollback")
+                "canary_promote", "canary_rollback",
+                "scale_up", "scale_down")
 
 _DETECTIONS = obs_metrics.counter(
     "heal_detections_total", "anomaly detections folded into the "
@@ -213,6 +222,12 @@ DEFAULT_POLICY: dict[str, HealRule] = {
     "serve_p99_breach": HealRule("slo_tighten"),
     "rank_lost": HealRule("quarantine", flap_n=3),
     "canary_regression": HealRule("canary_rollback", flap_n=1),
+    "serve_overload": HealRule("scale_up"),
+    # Shrinking trades capacity for efficiency — demand a LONGER
+    # period of proof than growth does (scale-down flaps are the
+    # classic autoscaler failure: shed replicas into a lull, then
+    # breach the SLO when the next burst lands on the smaller fleet).
+    "serve_underload": HealRule("scale_down", flap_n=4),
 }
 
 
@@ -663,6 +678,71 @@ class ServeWatcher:
         return []
 
 
+class AutoscaleWatcher:
+    """Scrape the serve fleet's offered load (``stats_fn`` →
+    ``{"offered_per_s", "replicas", ...}``) against the measured SLO
+    knee — the best in-SLO per-replica throughput a SERVE_lm record
+    proved (``throughput_vs_slo``) — and emit ``serve_overload`` while
+    offered load exceeds the fleet's in-SLO capacity
+    (``replicas × knee × headroom``) and ``serve_underload`` while the
+    fleet idles under ``low_water`` of it.  Both directions carry their
+    own recovery-re-armed episodes (ServeWatcher's pattern): load that
+    breaches, recovers, and breaches again deserves a fresh decision,
+    not a cooldown leftover."""
+
+    def __init__(self, stats_fn, knee_per_replica: float, *,
+                 headroom: float = 0.85, low_water: float = 0.35,
+                 min_replicas: int = 1, scope: str = "serve"):
+        self.stats_fn = stats_fn
+        self.knee = float(knee_per_replica)
+        self.headroom = headroom
+        self.low_water = low_water
+        self.min_replicas = min_replicas
+        self.scope = scope
+        self._episode = {"up": 0, "down": 0}
+        self._held = {"up": False, "down": False}
+
+    def _event(self, direction: str, kind: str, offered: float,
+               replicas: int, capacity: float) -> AnomalyEvent:
+        e = self._episode[direction]
+        self._held[direction] = True
+        return AnomalyEvent(
+            kind=kind, key=f"serve_load:{direction}:e{e}",
+            scope=self.scope, source="scrape", episode=f"e{e}",
+            detail={"offered_per_s": round(offered, 3),
+                    "capacity_per_s": round(capacity, 3),
+                    "replicas": replicas,
+                    "knee_per_replica": self.knee})
+
+    def _recover(self, direction: str) -> None:
+        if self._held[direction]:
+            self._held[direction] = False
+            self._episode[direction] += 1
+
+    def poll(self) -> list[AnomalyEvent]:
+        try:
+            stats = self.stats_fn() or {}
+        except Exception:             # noqa: BLE001 — a scrape failing
+            return []                 # must read as "no data", never die
+        offered = stats.get("offered_per_s")
+        replicas = stats.get("replicas")
+        if offered is None or not replicas:
+            return []
+        capacity = replicas * self.knee * self.headroom
+        if offered > capacity:
+            self._recover("down")
+            return [self._event("up", "serve_overload", offered,
+                                replicas, capacity)]
+        if (replicas > self.min_replicas
+                and offered < replicas * self.knee * self.low_water):
+            self._recover("up")
+            return [self._event("down", "serve_underload", offered,
+                                replicas, capacity)]
+        self._recover("up")
+        self._recover("down")
+        return []
+
+
 # --- actuator factories ----------------------------------------------------
 
 class FleetTarget:
@@ -763,6 +843,49 @@ def make_slo_actuator(get_slo, set_slo, target_ms: float):
         return {"slo_ms": new, "was": current,
                 "p99_ms": ev.detail.get("p99_ms")}
     return tighten
+
+
+def make_autoscale_actuator(get_replicas, set_replicas, *,
+                            knee_per_replica: float,
+                            min_replicas: int = 1,
+                            max_replicas: int = 8,
+                            headroom: float = 0.85):
+    """Overload/underload → resize the serve replica fleet against the
+    measured knee: the target is the replica count whose in-SLO
+    capacity (``replicas × knee × headroom``) covers the offered load,
+    clamped to ``[min_replicas, max_replicas]`` and to ONE step per
+    action in the shrink direction (an autoscaler may chase a spike up
+    quickly, but giving capacity back is done a replica at a time — a
+    mis-measured lull must not halve the fleet).  At the max-replica
+    ceiling an overload answers ``noop`` — the loud "policy cannot help
+    further, operator must grow the ceiling" refusal, which costs no
+    budget and no cooldown.  Idempotent: re-scaling to the current
+    count is a no-op with a truthful row."""
+    def scale(ev: AnomalyEvent) -> dict:
+        current = int(get_replicas())
+        offered = float(ev.detail.get("offered_per_s") or 0.0)
+        want = max(min_replicas, math.ceil(
+            offered / (knee_per_replica * headroom))
+            if offered > 0 else min_replicas)
+        if ev.kind == "serve_overload":
+            target = min(max_replicas, max(current + 1, want))
+            if current >= max_replicas:
+                return {"noop": f"already at max_replicas "
+                                f"{max_replicas} — the policy cannot "
+                                f"add capacity; raise the ceiling or "
+                                f"shed load (slo_tighten)"}
+        else:
+            target = max(min_replicas, min(current - 1, want))
+            if current <= min_replicas:
+                return {"noop": f"already at min_replicas "
+                                f"{min_replicas}"}
+        if target == current:
+            return {"noop": f"already at target {current} replica(s)"}
+        set_replicas(target)
+        return {"replicas": target, "was": current,
+                "offered_per_s": round(offered, 3),
+                "knee_per_replica": knee_per_replica}
+    return scale
 
 
 # --- the self-healing fleet runner -----------------------------------------

@@ -100,6 +100,13 @@ SCHED_EVENTS = (
 )
 # KEEP-IN-SYNC-END(sched-events)
 
+# The tick-loop sleep seam: sim/clock.py swaps this for a virtual
+# sleep that advances the simulated clock and fires due world events,
+# so the REAL policy loop below runs unmodified at fleet scale.  All
+# in-loop clock reads go through obs_metrics._now/_wall for the same
+# reason (the clock-seam lint rule proves no bare read sneaks back in).
+_sleep = time.sleep
+
 _DECISIONS = obs_metrics.counter(
     "sched_decisions_total", "scheduler decisions applied, by action")
 _QUEUE_DEPTH = obs_metrics.gauge(
@@ -173,6 +180,9 @@ class Job:
     retries: int = 1               # scheduler-level requeues (crashes)
     fleet_retries: int = 1         # gang restarts INSIDE one placement
     snapshots: str = ""            # per-rank SnapshotStore template
+    state_bytes: int = 0           # snapshot state size — prices the
+    #                              # cross-slice migration a multi-slice
+    #                              # eviction may force on the victim
     elastic: bool = True           # shrink on rank loss (sync state)
     worker_tiled: bool = False     # async state: shrink is illegal
     wall_timeout_s: float = 0.0    # 0 = derive from predicted cost
@@ -277,6 +287,28 @@ def predict_cost(job: Job, trajectory_path: str = "") -> dict:
             "predicted_s": predicted, "source": source}
 
 
+def load_collective_fit(path: str, devices: int) -> dict | None:
+    """Read the fitted ``t(S) = alpha + S/beta`` psum line for the
+    nearest measured device count out of a BENCH_collectives record
+    (``knees.psum.<devices>.{alpha_s, beta_bytes_per_s}``) — the price
+    model for moving a victim's snapshot state across slices.  Missing
+    or malformed records read as "no fit" (pricing degrades to
+    unpriced), never raise."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        knees = rec["detail"]["knees"]["psum"]
+        fits = {int(k): v for k, v in knees.items()}
+        nearest = min(fits, key=lambda d: (abs(d - devices), d))
+        fit = fits[nearest]
+        return {"alpha_s": float(fit["alpha_s"]),
+                "beta_bytes_per_s": float(fit["beta_bytes_per_s"]),
+                "fit_devices": nearest, "file": os.path.basename(path)}
+    except (OSError, KeyError, TypeError, ValueError,
+            json.JSONDecodeError):
+        return None
+
+
 # --- per-job runtime state -------------------------------------------------
 
 @dataclasses.dataclass
@@ -295,6 +327,7 @@ class _JobState:
     admitted: bool = False
     cost: dict = dataclasses.field(default_factory=dict)
     ran: bool = False              # a previous placement left snapshots
+    slice_name: str = ""           # which mesh slice the gang holds
     fleet: FleetSupervisor | None = None
     thread: threading.Thread | None = None
     result: list = dataclasses.field(default_factory=list)
@@ -318,10 +351,41 @@ class Scheduler:
                  max_job_s: float = 0.0,
                  trajectory_path: str = "",
                  retry_policy: RetryPolicy | None = None,
-                 heal: bool = True):
-        if devices < 1:
-            raise ValueError(f"devices {devices} must be >= 1")
+                 heal: bool = True,
+                 slices: dict[str, int] | None = None,
+                 collective_fit: dict | None = None,
+                 fleet_factory=None):
+        # Multi-slice packing: ``slices`` maps mesh-slice name →
+        # device capacity (TF-Replicator's placement separation one
+        # level up: a gang holds ONE slice, never spans two).  None =
+        # the classic single-mesh mode — one implicit slice named
+        # "mesh", every row byte-identical to the pre-slice scheduler.
+        if slices is not None:
+            if not slices:
+                raise ValueError("slices must name at least one slice")
+            for name, cap in slices.items():
+                if not name or not isinstance(name, str):
+                    raise ValueError(f"slice name {name!r} must be a "
+                                     f"non-empty string")
+                if not isinstance(cap, int) or cap < 1:
+                    raise ValueError(f"slice {name}: capacity {cap!r} "
+                                     f"must be an int >= 1")
+            self.slices = dict(slices)
+            devices = sum(self.slices.values())
+        else:
+            if devices < 1:
+                raise ValueError(f"devices {devices} must be >= 1")
+            self.slices = {"mesh": devices}
+        self._multi = slices is not None
         self.devices = devices
+        # The fitted collective model (load_collective_fit) pricing a
+        # cross-slice eviction: the victim's snapshot state may have to
+        # move slices on relaunch, t(S) = alpha + S/beta per rank.
+        self.collective_fit = collective_fit
+        # The spawn seam: sim/fleet.py injects a factory returning
+        # simulated gangs with the FleetSupervisor run/stop/ranks
+        # surface; the DECISION code below stays identical either way.
+        self.fleet_factory = fleet_factory or FleetSupervisor
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.journal = journal or Journal(
@@ -560,8 +624,8 @@ class Scheduler:
                  f"{pid}) from a dead scheduler incarnation")
         # TERM first (lets a live trainer save); escalate after the
         # shared grace — the relaunch must not race a dying writer.
-        deadline = time.monotonic() + 5.0
-        while live and time.monotonic() < deadline:
+        deadline = obs_metrics._now() + 5.0
+        while live and obs_metrics._now() < deadline:
             still = []
             for key, pid in live:
                 try:
@@ -571,7 +635,7 @@ class Scheduler:
                     continue
             live = still
             if live:
-                time.sleep(0.05)
+                _sleep(0.05)
         for _, pid in live:
             try:
                 os.killpg(pid, signal.SIGKILL)
@@ -586,13 +650,24 @@ class Scheduler:
         (unplaceable width / over the per-job cost ceiling)."""
         job = st.job
         cost = predict_cost(job, self.trajectory_path)
-        if job.ranks > self.devices:
+        widest = max(self.slices.values())
+        if job.ranks > widest:
             seq = self._intent("refuse", job.job)
             st.state = "refused"
-            st.why_last = (f"needs {job.ranks} device(s), mesh has "
-                           f"{self.devices}")
-            self._applied(seq, "refuse", job.job, why=st.why_last,
-                          ranks=job.ranks, devices=self.devices)
+            if self._multi:
+                # A gang holds ONE slice: wider than the widest slice
+                # is unplaceable even with the whole fleet idle.
+                st.why_last = (f"needs {job.ranks} device(s), widest "
+                               f"slice has {widest} "
+                               f"(slices: {self.slices})")
+                self._applied(seq, "refuse", job.job, why=st.why_last,
+                              ranks=job.ranks, devices=self.devices,
+                              slices=dict(self.slices))
+            else:
+                st.why_last = (f"needs {job.ranks} device(s), mesh has "
+                               f"{self.devices}")
+                self._applied(seq, "refuse", job.job, why=st.why_last,
+                              ranks=job.ranks, devices=self.devices)
             return False
         if self.max_job_s and cost["predicted_s"] \
                 and cost["predicted_s"] > self.max_job_s:
@@ -617,13 +692,16 @@ class Scheduler:
             return self.cost_margin * st.cost["predicted_s"]
         return 0.0
 
-    def _launch(self, st: _JobState, free: int) -> None:
+    def _launch(self, st: _JobState, free: int,
+                slice_name: str = "mesh") -> None:
         job = st.job
         st.launches += 1
         seq = self._intent("place", job.job, ranks=job.ranks,
-                           attempt=st.launches)
+                           attempt=st.launches,
+                           **({"slice": slice_name} if self._multi
+                              else {}))
         jdir = self._job_dir(job.job)
-        fleet = FleetSupervisor(
+        fleet = self.fleet_factory(
             job.ranks,
             policy=RetryPolicy(retries=job.fleet_retries,
                                backoff_base_s=0.05, backoff_max_s=0.5),
@@ -645,6 +723,7 @@ class Scheduler:
         st.fleet = fleet
         st.state = "running"
         st.width = job.ranks
+        st.slice_name = slice_name
         st.stop = None
         st.result = []
         resumed = st.ran
@@ -675,8 +754,12 @@ class Scheduler:
                       attempt=st.launches, resumed=resumed,
                       free_before=free, devices=self.devices,
                       wall_timeout_s=round(self._wall_timeout(st), 3)
-                      or None, **st.cost)
-        _log(f"{job.job}: placed on {job.ranks}/{self.devices} device(s) "
+                      or None,
+                      **({"slice": slice_name} if self._multi else {}),
+                      **st.cost)
+        where = (f"slice {slice_name}" if self._multi
+                 else f"{job.ranks}/{self.devices} device(s)")
+        _log(f"{job.job}: placed on {where} "
              f"(attempt {st.launches}"
              + (f", resuming" if resumed else "") + ")")
 
@@ -686,6 +769,35 @@ class Scheduler:
 
     def _free(self) -> int:
         return self.devices - sum(s.width for s in self._running())
+
+    def _slice_free(self) -> dict[str, int]:
+        """Free devices per slice (single-mesh mode: one entry)."""
+        free = dict(self.slices)
+        for s in self._running():
+            if s.slice_name in free:
+                free[s.slice_name] -= s.width
+        return free
+
+    def _pick_slice(self, ranks: int, frees: dict[str, int]) -> str | None:
+        """Best-fit packing: the slice with the LEAST free capacity
+        that still fits ``ranks`` — wide future jobs keep a wide slice
+        open instead of every slice fragmenting a little.  Name-sorted
+        tie-break keeps placement deterministic."""
+        fits = [(free, name) for name, free in sorted(frees.items())
+                if free >= ranks]
+        return min(fits)[1] if fits else None
+
+    def _migrate_price_s(self, st: _JobState) -> float | None:
+        """What evicting ``st`` may cost in collective-model time: its
+        per-rank snapshot state crossing slices on relaunch, priced at
+        the fitted ``t(S) = alpha + S/beta`` per rank.  None = unpriced
+        (no fit, or the job declares no state)."""
+        fit = self.collective_fit
+        if not fit or not st.job.state_bytes:
+            return None
+        t = (fit["alpha_s"]
+             + st.job.state_bytes / fit["beta_bytes_per_s"])
+        return round(t * max(1, st.width or st.job.ranks), 6)
 
     def _reap(self) -> None:
         for st in self._running():
@@ -756,8 +868,14 @@ class Scheduler:
             elif reason == "evicted":
                 st.preemptions += 1
                 for_job, why = detail
+                extra = ({"slice": st.slice_name} if self._multi
+                         else {})
+                price = (self._migrate_price_s(st) if self._multi
+                         else None)
+                if price is not None:
+                    extra["price_s"] = price
                 self._applied(seq, "evict", job.job, for_job=for_job,
-                              why=why, rcs=rcs, clean=clean)
+                              why=why, rcs=rcs, clean=clean, **extra)
                 _log(f"{job.job}: evicted ({why}); requeued — "
                      f"preemptions are not charged to the retry budget")
             # scheduler_terminated: queued for the next incarnation,
@@ -801,7 +919,7 @@ class Scheduler:
         delay = self.retry_policy.delay_s(st.retries_used - 1,
                                           self._rng.random())
         st.state = "queued"
-        st.not_before = time.monotonic() + delay
+        st.not_before = obs_metrics._now() + delay
         seq = self._intent("retry", job.job, retry=st.retries_used)
         self._applied(seq, "retry", job.job, retry=st.retries_used,
                       of=job.retries, backoff_s=round(delay, 3), why=why)
@@ -843,8 +961,12 @@ class Scheduler:
         # across ticks while the stopped gang drains, or a second
         # shrunken job recovering one tick later double-books the same
         # devices — giving up its working gang for capacity that was
-        # never there.
-        free = self._free() - sum(
+        # never there.  (Multi-slice: the relaunch may land on ANY
+        # slice, so the gate is "some slice fits the full width once
+        # this gang's devices return", with the pending reservations
+        # held against it conservatively.)
+        frees = self._slice_free()
+        reserved = sum(
             s.job.ranks - s.width for s in self._running()
             if s.stop is not None and s.stop[0] == "grow")
         for st in self._running():
@@ -855,9 +977,13 @@ class Scheduler:
             recovered = fleet.probe_lost_ranks(list(st.job.argv))
             if not recovered:
                 continue
-            if free < st.job.ranks - st.width:
+            roomiest = max(
+                frees.get(name, 0)
+                + (st.width if name == st.slice_name else 0)
+                for name in self.slices)
+            if roomiest - reserved < st.job.ranks:
                 continue        # no room for the regrown width yet
-            free -= st.job.ranks - st.width
+            reserved += st.job.ranks - st.width
             seq = self._intent("grow", st.job.job, recovered=recovered)
             st.stop = ("grow", seq, recovered)
             fleet.request_stop("grow")
@@ -913,8 +1039,12 @@ class Scheduler:
         # in what it frees (plus what is already free) — evicting a
         # straggler for a head job still too wide to fit is pure
         # evict-relaunch churn, burning the action budget and the
-        # victim's wall time with zero queued work served.
-        fits = self._free() + st.width
+        # victim's wall time with zero queued work served.  Multi-
+        # slice: the beneficiary may land on the victim's slice (its
+        # free + the victim's width) or any other slice's own free.
+        frees = self._slice_free()
+        fits = max(frees.get(st.slice_name, 0) + st.width,
+                   max(frees.values()))
         head = next((w for w in waiting if w.job.ranks <= fits), None)
         if head is None:
             return {"noop": f"no queued job fits the {fits} device(s) "
@@ -930,15 +1060,20 @@ class Scheduler:
         _log(f"{st.job.job}: requesting clean stop — {why}")
         return {"for_job": head.job.job, "stragglers": stragglers}
 
-    def _evict_for(self, head: _JobState, free: int) -> bool:
-        """SLO preemption: free enough devices for ``head`` by cleanly
-        stopping strictly-less-urgent running jobs — least urgent
-        first, youngest first among equals.  Returns whether enough
-        capacity is (or will shortly be) freed."""
+    def _evict_plan(self, head: _JobState, slice_name: str,
+                    free: int) -> tuple | None:
+        """One slice's eviction plan for ``head``: the strictly-less-
+        urgent victims (least urgent first, youngest first among
+        equals) whose widths cover the shortfall, plus the plan's
+        cross-slice migration price (sum of the victims' fitted
+        collective-model costs; unpriced victims count separately so a
+        zero price is never confused with an unknown one).  None = the
+        slice cannot be cleared for ``head`` at all."""
         need = head.job.ranks - free
         victims = sorted(
             (s for s in self._running()
-             if s.stop is None and s.priority > head.priority),
+             if s.stop is None and s.priority > head.priority
+             and s.slice_name == slice_name),
             key=lambda s: (-s.priority, -s.submit_idx))
         chosen: list[_JobState] = []
         for v in victims:
@@ -947,13 +1082,44 @@ class Scheduler:
             chosen.append(v)
             need -= v.width
         if need > 0:
+            return None
+        prices = [self._migrate_price_s(v) for v in chosen]
+        priced = round(sum(p for p in prices if p), 6)
+        unpriced = sum(1 for p in prices if p is None)
+        return (priced, unpriced, len(chosen), slice_name, chosen)
+
+    def _evict_for(self, head: _JobState,
+                   frees: dict[str, int]) -> bool:
+        """SLO preemption: free enough devices for ``head`` by cleanly
+        stopping strictly-less-urgent running jobs in ONE slice —
+        cheapest clearable slice first, priced by the fitted collective
+        model (a victim with snapshot state pays its possible
+        cross-slice move).  Returns whether enough capacity is (or will
+        shortly be) freed."""
+        plans = [p for p in (
+            self._evict_plan(head, name, frees[name])
+            for name in sorted(self.slices)
+            if self.slices[name] >= head.job.ranks) if p is not None]
+        if not plans:
             return False
+        priced, unpriced, nvict, slice_name, chosen = min(
+            plans, key=lambda p: p[:4])
+        free = frees[slice_name]
         for v in chosen:
             why = (f"evicted for higher-priority job `{head.job.job}` "
                    f"(priority {head.priority} {head.job.kind} vs "
                    f"{v.priority} {v.job.kind}; it needs "
-                   f"{head.job.ranks} device(s), {free} free)")
-            seq = self._intent("evict", v.job.job, for_job=head.job.job)
+                   f"{head.job.ranks} device(s), {free} free"
+                   + (f" in slice {slice_name}" if self._multi else "")
+                   + ")")
+            extra = {}
+            if self._multi:
+                extra["slice"] = slice_name
+                price = self._migrate_price_s(v)
+                if price is not None:
+                    extra["price_s"] = price
+            seq = self._intent("evict", v.job.job,
+                               for_job=head.job.job, **extra)
             v.stop = ("evicted", seq, (head.job.job, why))
             v.fleet.request_stop("evicted")
             _log(f"{v.job.job}: requesting clean stop — {why}")
@@ -964,9 +1130,9 @@ class Scheduler:
         self._observe_running()
         self._drive_grow()
         self._drive_heal()
-        now = time.monotonic()
-        free = self._free()
-        _DEVICES_BUSY.set(self.devices - free)
+        now = obs_metrics._now()
+        frees = self._slice_free()
+        _DEVICES_BUSY.set(self.devices - sum(frees.values()))
         ready = [s for s in self._jobs.values()
                  if s.state == "queued" and now >= s.not_before
                  and now - t0 >= s.job.start_after_s
@@ -979,12 +1145,13 @@ class Scheduler:
         for st in ready:
             if not st.admitted and not self._admit(st):
                 continue
-            if st.job.ranks <= free:
-                self._launch(st, free)
-                free -= st.job.ranks
+            slice_name = self._pick_slice(st.job.ranks, frees)
+            if slice_name is not None:
+                self._launch(st, frees[slice_name], slice_name)
+                frees[slice_name] -= st.job.ranks
             else:
                 if not evicting:
-                    self._evict_for(st, free)
+                    self._evict_for(st, frees)
                 # Head-of-priority capacity blocking: once the most
                 # urgent ready job cannot be placed, nothing less
                 # urgent may admit this tick.  Backfilling a just-freed
@@ -1028,7 +1195,7 @@ class Scheduler:
         scheduler cleanly: running gangs are evicted (they save), queued
         jobs stay queued, and a rerun of the same command resumes from
         the journal."""
-        t0 = time.monotonic()
+        t0 = obs_metrics._now()
         self._replay()
         for st in sorted(self._jobs.values(), key=lambda s: s.submit_idx):
             if st.job.job not in self._submitted:
@@ -1050,20 +1217,20 @@ class Scheduler:
                     break
                 self._tick(t0)
                 self._fail_dead_gates()
-                time.sleep(self.tick_s)
+                _sleep(self.tick_s)
             else:
                 self._reap()
-        return self._summary(status, time.monotonic() - t0)
+        return self._summary(status, obs_metrics._now() - t0)
 
     def _shutdown(self) -> None:
         for st in self._running():
             if st.fleet is not None:
                 st.stop = ("scheduler_terminated", None, None)
                 st.fleet.request_stop("scheduler_terminated")
-        deadline = time.monotonic() + 30.0
-        while self._running() and time.monotonic() < deadline:
+        deadline = obs_metrics._now() + 30.0
+        while self._running() and obs_metrics._now() < deadline:
             self._reap()
-            time.sleep(self.poll_s)
+            _sleep(self.poll_s)
         _log("terminated — running gangs stopped cleanly; rerun the "
              "same command to resume the queue from the journal")
 
@@ -1080,6 +1247,7 @@ class Scheduler:
         summary = {
             "status": status, "jobs": states, "counts": counts,
             "devices": self.devices,
+            **({"slices": dict(self.slices)} if self._multi else {}),
             "makespan_s": round(makespan_s, 3),
             "evictions": evictions, "shrinks": shrinks, "grows": grows,
             "retries": retries,

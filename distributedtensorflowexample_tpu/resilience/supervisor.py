@@ -167,7 +167,11 @@ class Journal:
     def write(self, event: str, **fields) -> None:
         if not self._path:
             return
-        rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        # Through the obs/metrics.py wall seam, not bare time.time():
+        # journal rows are the WAL the sim's virtual clock must pin, or
+        # two same-seed sim runs differ in every ts field.
+        rec = {"ts": round(obs_metrics._wall(), 3), "event": event,
+               **fields}
         # Heal a torn tail BEFORE appending: a journal write that died
         # mid-line (or the journal_torn fault) leaves no trailing
         # newline, and appending straight onto the fragment would merge

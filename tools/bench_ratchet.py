@@ -159,7 +159,7 @@ def check_zero_invariants(records: list[dict],
     series: dict = {}
     for rec in records:
         metric = rec.get("metric", "")
-        if metric.endswith(("_lost", "_mismatch")):
+        if metric.endswith(("_lost", "_mismatch", "_violations")):
             series.setdefault((metric, _platform(rec)), []).append(rec)
     findings = []
     for (metric, platform), recs in sorted(series.items()):
@@ -194,7 +194,8 @@ def compare_records(records: list[dict], tolerance: float,
     magnitude, whichever direction that metric worsens in."""
     series: dict = {}
     for rec in records:
-        if rec.get("metric", "").endswith(("_lost", "_mismatch")):
+        if rec.get("metric", "").endswith(
+                ("_lost", "_mismatch", "_violations")):
             # check_zero_invariants owns the must-be-zero family: here
             # a fixed loss (1 -> 0) would read as a 100% "drop".
             continue
@@ -363,12 +364,16 @@ def build_trajectory(records_dir: str) -> list[dict]:
     # (tools/schedule.py --record), SERVE_* the serving bench family
     # (bench_serving.py throughput-vs-SLO curves), and HEAL_* the
     # remediation-drill family (tools/heal_drill.py mttd/mttr/
-    # steps-lost): the same metric-row dialect as the bench families,
+    # steps-lost), and SIM_* the fleet-simulator battery
+    # (tools/sim_run.py --battery: queue waits, MTTR tails, and the
+    # determinism/steps-lost/WAL must-be-zero invariants at 10k
+    # simulated ranks): the same metric-row dialect as the bench
+    # families,
     # so the control plane's, the serving path's, and the self-healing
     # layer's numbers ride the same trajectory/ratchet surface as
     # every other measured thing.
     for pattern in ("BENCH_*.json", "SCHED_*.json", "SERVE_*.json",
-                    "HEAL_*.json"):
+                    "HEAL_*.json", "SIM_*.json"):
         for path in sorted(glob.glob(os.path.join(records_dir,
                                                   pattern))):
             if os.path.basename(path) == _TRAJECTORY_NAME:
@@ -468,12 +473,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--records_dir", default=_REPO,
                    help="where the BENCH_*.json records live")
     p.add_argument("--glob", default="BENCH_*.json,SERVE_*.json,"
-                                     "HEAL_*.json",
+                                     "HEAL_*.json,SIM_*.json",
                    help="comma-separated record patterns the prior-"
                         "record ratchet scans (the serving and heal "
                         "families regress like any bench family; heal "
-                        "*_ms metrics gate lower-is-better and *_lost "
-                        "/ *_mismatch must stay zero)")
+                        "*_ms metrics gate lower-is-better and *_lost / "
+                        "*_mismatch / *_violations must stay zero)")
     p.add_argument("--baseline", default="",
                    help="BASELINE_SELF.json (default: in records_dir)")
     p.add_argument("--tolerance", type=float, default=0.10,

@@ -296,7 +296,7 @@ _TERMINAL_WHY = {"sched_done": "completed", "sched_fail": "failed",
 # Renderers for the remediation engine's heal_* ledger rows — one entry
 # per decision class resilience/remediate.py can write; unknown heal_*
 # rows render generically (same contract as the sched_* table above).
-# KEEP-IN-SYNC(heal-events) digest=b5297afabbec
+# KEEP-IN-SYNC(heal-events) digest=0b62c0ca8c20
 _HEAL_RENDER = {
     "heal_detect": lambda r: (
         f"anomaly detected: {r.get('kind')}"
@@ -320,6 +320,12 @@ _HEAL_RENDER = {
         f"canary PROMOTED: {r.get('detail')}"),
     "heal_canary_rollback": lambda r: (
         f"canary ROLLED BACK ({r.get('kind')}): {r.get('detail')}"),
+    "heal_scale_up": lambda r: (
+        f"SCALED UP ({r.get('kind')}): serve fleet grown against the "
+        f"measured SLO knee ({r.get('detail')})"),
+    "heal_scale_down": lambda r: (
+        f"SCALED DOWN ({r.get('kind')}): serve fleet shrunk — "
+        f"sustained underload ({r.get('detail')})"),
     "heal_suppressed": lambda r: (
         f"action {r.get('action')} on {r.get('kind')} SUPPRESSED by "
         f"guardrail: {r.get('reason')}"),
