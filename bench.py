@@ -372,56 +372,48 @@ def _make(model_name: str, dataset: str, batch_per_chip: int, unroll: int,
           fused_opt: bool = False, augment: str = "none", lr: float = 0.05,
           sync: bool = True, async_period: int = 8,
           data_dir: str | None = None, dequant_impl: str = "auto"):
-    import optax
+    """One knob config as an Engine declaration (engine/engine.py —
+    the same construction stack run_training wires, minus hooks).  The
+    input_fn/optimizer_fn seams carry the two bench-only policies: the
+    fallback data source (the bench must run on a data-less chip host)
+    and the bare float-LR optimizer (a schedule-wrapped twin has a
+    DIFFERENT opt_state pytree — the step program must stay the
+    measured trainer program, bitwise)."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.engine import Engine, RunSpec
 
-    from distributedtensorflowexample_tpu.data import DeviceDataset
-    from distributedtensorflowexample_tpu.data.cifar10 import load_cifar10
-    from distributedtensorflowexample_tpu.data.mnist import load_mnist
-    from distributedtensorflowexample_tpu.models import build_model
-    from distributedtensorflowexample_tpu.parallel import replicated_sharding
-    from distributedtensorflowexample_tpu.parallel.async_ps import (
-        make_indexed_async_train_step, make_worker_state)
-    from distributedtensorflowexample_tpu.parallel.sync import (
-        make_indexed_train_step)
-    from distributedtensorflowexample_tpu.training.state import TrainState
+    def input_fn(cfg, split):
+        from distributedtensorflowexample_tpu.data.cifar10 import (
+            load_cifar10)
+        from distributedtensorflowexample_tpu.data.mnist import load_mnist
+        load = load_mnist if dataset == "mnist" else load_cifar10
+        # Resolved at call time (not def time) so tests can repoint
+        # DATA_DIR.
+        return load(data_dir if data_dir is not None else DATA_DIR,
+                    split, source="fallback")
 
-    num_chips = mesh.size
-    global_batch = batch_per_chip * num_chips
-    load = load_mnist if dataset == "mnist" else load_cifar10
-    sample = (28, 28, 1) if dataset == "mnist" else (32, 32, 3)
-    # Resolved at call time (not def time) so tests can repoint DATA_DIR.
-    # source="fallback": the bench must run on a data-less chip host (real
-    # bytes when mounted, loud synthetic warning otherwise) — the trainer
-    # surface's strict default doesn't apply to the harness.
-    train_x, train_y = load(data_dir if data_dir is not None else DATA_DIR,
-                            "train", source="fallback")
-    ds = DeviceDataset(train_x, train_y, global_batch, mesh=mesh, seed=0,
-                       steps_per_next=unroll, dequant_impl=dequant_impl)
+    def optimizer_fn(cfg, _mesh, wrap_shard_update):
+        import optax
+        if fused_opt:
+            from distributedtensorflowexample_tpu.ops.pallas import (
+                fused_momentum_sgd)
+            return fused_momentum_sgd(lr, momentum=momentum, mesh=_mesh)
+        if momentum > 0:
+            return optax.sgd(lr, momentum=momentum)
+        return optax.sgd(lr)
 
-    model = build_model(model_name, dropout=0.5)
-    if fused_opt:
-        from distributedtensorflowexample_tpu.ops.pallas import (
-            fused_momentum_sgd)
-        tx = fused_momentum_sgd(lr, momentum=momentum, mesh=mesh)
-    elif momentum > 0:
-        tx = optax.sgd(lr, momentum=momentum)
-    else:
-        tx = optax.sgd(lr)
-    state = TrainState.create_sharded(
-        model, tx, (global_batch,) + sample, 0, replicated_sharding(mesh))
-    if sync:
-        step = make_indexed_train_step(global_batch, ds.steps_per_epoch,
-                                       mesh=mesh, unroll_steps=unroll,
-                                       ce_impl=ce_impl, augment=augment,
-                                       num_slots=ds.num_slots,
-                                       dequant_impl=dequant_impl)
-    else:
-        state = make_worker_state(state, num_chips, mesh)
-        step = make_indexed_async_train_step(
-            num_chips, async_period, global_batch, ds.steps_per_epoch,
-            ce_impl=ce_impl, mesh=mesh, unroll_steps=unroll, augment=augment,
-            num_slots=ds.num_slots, dequant_impl=dequant_impl)
-    return step, ds, state, unroll
+    cfg = RunConfig(batch_size=batch_per_chip, seed=0,
+                    learning_rate=lr, momentum=momentum,
+                    sync_mode="sync" if sync else "async",
+                    async_period=async_period,
+                    pallas_ce=(ce_impl == "pallas"),
+                    fused_optimizer=fused_opt,
+                    dequant_impl=dequant_impl)
+    spec = RunSpec(model=model_name, dataset=dataset, config=cfg,
+                   augment=(augment == "cifar"), input_fn=input_fn,
+                   optimizer_fn=optimizer_fn)
+    built = Engine(spec).build(mesh=mesh, unroll=unroll)
+    return built.step, built.ds, built.state, built.unroll
 
 
 def _roofline_probe(mesh, batch_per_chip: int, length: int = 256,

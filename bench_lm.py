@@ -100,70 +100,45 @@ def _build(size: str, mesh, batch_per_chip: int, seq_len: int,
            bucket: bool = False, shard_params: bool = False,
            overlap: bool = True, seed: int = 0,
            split_n: int | None = None):
-    """Dataset + state + jitted step for one knob config — the same
-    builders run_training wires (models registry, DeviceDataset
-    token_data, make_indexed_train_step, the shard_update/ZeRO-1
-    layout passes), so the bench measures the trainer's programs."""
-    import jax.numpy as jnp
-    import optax
-
-    from distributedtensorflowexample_tpu.data import DeviceDataset
-    from distributedtensorflowexample_tpu.data.lm import load_lm
-    from distributedtensorflowexample_tpu.models import build_model
-    from distributedtensorflowexample_tpu.parallel import replicated_sharding
+    """One knob config as an Engine declaration (engine/engine.py): the
+    Engine resolves the remat/shard_update/bucket_grads/shard_params
+    knobs into the SAME builders and layout passes run_training wires,
+    so the bench measures the trainer's programs.  input_fn pins the
+    bench's deterministic split sizing; optimizer_fn pins the bare
+    float-LR optax.sgd (a schedule-wrapped twin has a DIFFERENT
+    opt_state pytree — the measured program must stay the trainer's,
+    bitwise)."""
+    from distributedtensorflowexample_tpu.config import RunConfig
+    from distributedtensorflowexample_tpu.engine import Engine, RunSpec
     from distributedtensorflowexample_tpu.parallel.bucketing import (
-        DEFAULT_BUCKET_BYTES, init_bucketed_opt_state)
-    from distributedtensorflowexample_tpu.parallel.sync import (
-        make_indexed_train_step)
-    from distributedtensorflowexample_tpu.training.state import TrainState
+        DEFAULT_BUCKET_BYTES)
 
     D = mesh.size
     global_batch = batch_per_chip * D
     n = split_n if split_n is not None else max(global_batch * 8, 256)
-    x, y = load_lm("", "train", seed=seed, num=n, seq_len=seq_len)
-    ds = DeviceDataset(x, y, global_batch, mesh=mesh, seed=seed,
-                       steps_per_next=unroll, token_data=True)
-    model = build_model(size, dropout=0.0, remat=remat)
-    tx = optax.sgd(0.1, momentum=0.9)
-    bucket_bytes = DEFAULT_BUCKET_BYTES if bucket else None
-    zero3_on = shard_params and bool(bucket_bytes) and D > 1
-    bucket_zero1 = bool(bucket_bytes) and shard_update and D > 1 \
-        and not zero3_on
-    if shard_update and not (bucket_zero1 or zero3_on):
-        from distributedtensorflowexample_tpu.training.optimizers import (
-            cross_replica_update_sharding)
-        tx = cross_replica_update_sharding(tx, mesh)
-    state = TrainState.create_sharded(
-        model, tx, (global_batch, seq_len), seed, replicated_sharding(mesh))
-    zero3_layout = None
-    if zero3_on:
-        from distributedtensorflowexample_tpu.parallel.zero3 import (
-            Zero3Layout)
-        zero3_layout = Zero3Layout(state.params, bucket_bytes, mesh)
-        state = state.replace(opt_state=init_bucketed_opt_state(
-            optax.sgd(0.1, momentum=0.9), state.params,
-            bucket_bytes, mesh))
-        # init_rows DONATES the replicated params: from here on the full
-        # tree exists only as the step's per-bucket gathered temporaries.
-        state = state.replace(
-            params=zero3_layout.init_rows(state.params))
-    elif bucket_zero1:
-        state = state.replace(opt_state=init_bucketed_opt_state(
-            optax.sgd(0.1, momentum=0.9), state.params,
-            bucket_bytes, mesh))
-    elif shard_update:
-        import jax
 
-        from distributedtensorflowexample_tpu.training.optimizers import (
-            update_shardings)
-        state = state.replace(opt_state=jax.device_put(
-            state.opt_state, update_shardings(state.opt_state, mesh)))
-    step = make_indexed_train_step(
-        global_batch, ds.steps_per_epoch, mesh=mesh, unroll_steps=unroll,
-        num_slots=ds.num_slots, bucket_bytes=bucket_bytes,
-        bucket_shard_update=bucket_zero1, zero3_layout=zero3_layout,
-        zero3_overlap=overlap)
-    return step, ds, state, global_batch
+    def input_fn(cfg, split):
+        from distributedtensorflowexample_tpu.data.lm import load_lm
+        return load_lm("", split, seed=seed, num=n, seq_len=seq_len)
+
+    def optimizer_fn(cfg, _mesh, wrap_shard_update):
+        import optax
+        tx = optax.sgd(0.1, momentum=0.9)
+        if cfg.shard_update and wrap_shard_update:
+            from distributedtensorflowexample_tpu.training.optimizers \
+                import cross_replica_update_sharding
+            tx = cross_replica_update_sharding(tx, _mesh)
+        return tx
+
+    cfg = RunConfig(batch_size=batch_per_chip, seed=seed, remat=remat,
+                    shard_update=shard_update,
+                    bucket_grads=str(DEFAULT_BUCKET_BYTES) if bucket else "",
+                    shard_params=shard_params, zero3_overlap=overlap,
+                    learning_rate=0.1, momentum=0.9, dropout=0.0)
+    spec = RunSpec(model=size, dataset="lm", config=cfg,
+                   input_fn=input_fn, optimizer_fn=optimizer_fn)
+    built = Engine(spec).build(mesh=mesh, unroll=unroll)
+    return built.step, built.ds, built.state, built.global_batch
 
 
 def _measure_rate(step, ds, state, steps: int, unroll: int,

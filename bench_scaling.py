@@ -121,19 +121,12 @@ def main() -> None:
             except RuntimeError:
                 pass
 
-    import jax.numpy as jnp
     import optax
 
-    from distributedtensorflowexample_tpu.data import DeviceDataset
+    from distributedtensorflowexample_tpu.config import RunConfig
     from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
-    from distributedtensorflowexample_tpu.models import build_model
-    from distributedtensorflowexample_tpu.parallel import (
-        make_mesh, replicated_sharding)
-    from distributedtensorflowexample_tpu.parallel.async_ps import (
-        make_indexed_async_train_step, make_worker_state)
-    from distributedtensorflowexample_tpu.parallel.sync import (
-        make_indexed_train_step)
-    from distributedtensorflowexample_tpu.training.state import TrainState
+    from distributedtensorflowexample_tpu.engine import Engine, RunSpec
+    from distributedtensorflowexample_tpu.parallel import make_mesh
     # Same warmup/best-of-repeats measurement the main bench uses.
     from bench import _measure
 
@@ -151,36 +144,36 @@ def main() -> None:
     for n in counts:
         mesh = make_mesh(n)
         global_batch = args.batch_per_chip * n
-        x, y = make_synthetic(global_batch * args.unroll * 2, (28, 28, 1),
-                              10, seed=0)
-        ds = DeviceDataset(x, y, global_batch, mesh=mesh, seed=0,
-                           steps_per_next=args.unroll)
-        model = build_model("mnist_cnn", dropout=0.5)
-        state = TrainState.create_sharded(
-            model, optax.sgd(0.05, momentum=0.9),
-            (global_batch, 28, 28, 1), 0, replicated_sharding(mesh))
-        if args.mode == "async":
-            state = make_worker_state(state, n, mesh)
 
-            def make_step(unroll):
-                return make_indexed_async_train_step(
-                    n, args.async_period, global_batch, ds.steps_per_epoch,
-                    mesh=mesh, unroll_steps=unroll)
-        else:
-            def make_step(unroll):
-                return make_indexed_train_step(
-                    global_batch, ds.steps_per_epoch, mesh=mesh,
-                    unroll_steps=unroll)
-        step = make_step(args.unroll)
+        def input_fn(cfg, split, _gb=global_batch):
+            return make_synthetic(_gb * args.unroll * 2, (28, 28, 1),
+                                  10, seed=0)
+
+        def optimizer_fn(cfg, _mesh, wrap_shard_update):
+            return optax.sgd(0.05, momentum=0.9)
+
+        # The config-1/2 workloads as Engine declarations
+        # (engine/engine.py): the Engine wires the same indexed
+        # sync/async step builders run_training measures.
+        spec = RunSpec(
+            model="mnist_cnn", dataset="mnist",
+            config=RunConfig(batch_size=args.batch_per_chip, seed=0,
+                             sync_mode=args.mode,
+                             async_period=args.async_period),
+            input_fn=input_fn, optimizer_fn=optimizer_fn)
+        built = Engine(spec).build(mesh=mesh, unroll=args.unroll)
+        step, ds, state = built.step, built.ds, built.state
         with mesh:
             # Per-step collective traffic from a SINGLE-step compile: in
             # the unrolled program the collectives live inside the scan
             # body (once in the module text, executed every sub-step), so
             # the one-step module is the honest per-step accounting.
             # peek, not next: lowering must not advance the perm ring
-            # ahead of state.step.
+            # ahead of state.step (the unroll-1 build's own dataset is
+            # discarded — only its compiled step is inspected).
             per_step = collective_traffic(
-                make_step(1).lower(state, ds.peek()).compile().as_text())
+                Engine(spec).build(mesh=mesh, unroll=1).step
+                .lower(state, ds.peek()).compile().as_text())
             best, rates, _ = _measure(step, ds, state, args.steps,
                                       args.unroll, warmup_calls=1)
         results[n] = {"steps_per_sec": best,

@@ -64,6 +64,11 @@ ENV_REGISTRY: dict[str, str] = {
     "HEAL_FLAP_WINDOW_S": (
         "The flap-damping window in seconds "
         "(resilience/remediate.py; default 60)."),
+    "HEAL_LR_DROP": (
+        "1 = experimental: map loss_plateau to the LR-drop advisory "
+        "stub instead of gang rollback — the actuator writes an "
+        "advisory file a future trainer LR hook consumes "
+        "(resilience/remediate.py)."),
     "OBS_ANOMALY_SKIP": (
         "Steps ignored at window start before the anomaly baseline "
         "arms (obs/anomaly.py; default 1 — the compile step)."),

@@ -1,7 +1,7 @@
 # graftlint: stdlib-only
 """Repo-invariant AST linter (the source front of graftlint).
 
-Five rules, each a static proof of a convention the repo previously
+Six rules, each a static proof of a convention the repo previously
 enforced by runtime probe or reviewer memory:
 
 * ``stdlib-only`` — whole-import-graph proof that obs/ (and any module
@@ -31,6 +31,16 @@ enforced by runtime probe or reviewer memory:
   mirrored tables (e.g. the capture-phase tables in
   tools/bench_capture.sh vs tools/supervise.py) fails the gate
   instead of waiting for an on-chip window to expose it.
+* ``engine-owns-wiring`` — the PR 19 front-end contract: raw
+  step-wiring names (the ``parallel/`` step builders, worker/opt-state
+  re-layout constructors, ``shard_map``) may be imported or referenced
+  only under ``engine/`` and ``parallel/``; everywhere else a workload
+  is a declarative RunSpec and ``engine.Engine`` owns the wiring.
+  Scope: package modules plus repo-root and ``tools/`` scripts
+  (``tests/`` exempt — parity tests drive the raw builders as ground
+  truth on purpose).  Standing exceptions live in
+  :data:`WIRING_ALLOWLIST` with one-line reasons; one-off escapes go
+  through the waiver budget like every other rule.
 
 Stdlib-only by construction (this module is itself under the
 ``stdlib-only`` rule via its tag).  All functions take the repo root +
@@ -47,7 +57,8 @@ import re
 from distributedtensorflowexample_tpu.analysis import Finding
 
 SRC_RULES = ("stdlib-only", "env-registry", "env-dynamic", "env-dead",
-             "named-refusal", "clock-seam", "keep-in-sync")
+             "named-refusal", "clock-seam", "keep-in-sync",
+             "engine-owns-wiring")
 
 STDLIB_TAG = "graftlint: stdlib-only"
 #: Import-time reachability to any of these fails the stdlib-only rule
@@ -661,6 +672,115 @@ def check_keep_in_sync(repo_root: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Engine-owns-wiring rule (PR 19).
+
+#: Raw step-wiring vocabulary: the ``parallel/`` step builders, the
+#: async worker / bucketed-opt / ZeRO-3 state re-layout constructors,
+#: and ``shard_map`` itself.  Importing or attribute-referencing any of
+#: these outside ``engine/``+``parallel/`` is a fork of the Engine's
+#: wiring (``make_mesh``/``create_sharded`` stay legal everywhere:
+#: ``Engine.build`` accepts a caller-built mesh by design).
+WIRING_NAMES = frozenset({
+    "make_train_step", "make_indexed_train_step", "make_async_train_step",
+    "make_indexed_async_train_step", "build_bucketed_step_fn",
+    "make_worker_state", "init_bucketed_opt_state", "Zero3Layout",
+    "shard_map"})
+
+#: Standing, reviewed exceptions (repo-relative path -> why raw wiring
+#: is that file's JOB, not a missed port).  Anything else that needs an
+#: escape goes through the waiver budget and therefore ratchets.
+WIRING_ALLOWLIST = {
+    "distributedtensorflowexample_tpu/compat.py":
+        "defines the shard_map version shim the ban protects",
+    "distributedtensorflowexample_tpu/ops/pallas/sgd.py":
+        "fused-optimizer kernel launch idiom — per-device pallas "
+        "dispatch under shard_map, not trainer wiring",
+    "distributedtensorflowexample_tpu/serving/sharded.py":
+        "sharded decode programs declare their own HLO contracts "
+        "(DESIGN.md §25) — serving's analogue of parallel/",
+    "distributedtensorflowexample_tpu/serving/promote.py":
+        "row promotion rides the Zero3Layout init_rows/materialize "
+        "seam; the training-template re-layout already goes through "
+        "engine.apply_update_layout",
+    "distributedtensorflowexample_tpu/analysis/hlo_lint.py":
+        "the contract checker compiles the raw builders on purpose",
+    "__graft_entry__.py":
+        "driver compile-check entry: exercises the raw step builders "
+        "as the pre-Engine dry-run surface",
+    "bench_collectives.py":
+        "raw-collective microbench — measures shard_map collectives "
+        "themselves, beneath any trainer",
+    "bench_serving.py":
+        "builds row-layout serving fixtures for the decode bench",
+    "tools/faultline.py":
+        "fault-injection drills drive a minimal raw step on purpose",
+}
+
+
+def check_engine_owns_wiring(repo_root: str, package: str,
+                             mods: dict[str, _Module] | None = None
+                             ) -> list[Finding]:
+    """Flag imports/attribute references of :data:`WIRING_NAMES`
+    outside ``engine/`` and ``parallel/`` — package modules plus
+    repo-root and ``tools/`` scripts (function-level imports count:
+    lazy wiring is still wiring).  Docstrings mentioning the names
+    never match (AST, not grep)."""
+    mods = mods if mods is not None else _load_package(repo_root, package)
+    targets: list[tuple[str, ast.AST]] = []
+    for dotted in sorted(mods):
+        if dotted.split(".")[0] in ("engine", "parallel"):
+            continue
+        targets.append((_rel(mods[dotted].path, repo_root),
+                        mods[dotted].tree))
+    for sub in ("", "tools"):
+        d = os.path.join(repo_root, sub) if sub else repo_root
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            targets.append((_rel(path, repo_root), tree))
+
+    findings: list[Finding] = []
+    for rel, tree in targets:
+        if rel in WIRING_ALLOWLIST:
+            continue
+        seen: set[str] = set()
+
+        def hit(name: str, lineno: int, rel=rel, seen=seen) -> None:
+            if name in seen:
+                return
+            seen.add(name)
+            findings.append(Finding(
+                "engine-owns-wiring", rel, lineno,
+                f"engine-owns-wiring:{rel}:{name}",
+                f"raw step-wiring name {name!r} referenced outside "
+                f"engine/ and parallel/ — declare a RunSpec and let "
+                f"engine.Engine own the wiring (standing exceptions: "
+                f"src_lint.WIRING_ALLOWLIST)"))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name.split(".")[-1] in WIRING_NAMES:
+                        hit(a.name.split(".")[-1], node.lineno)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[-1] in WIRING_NAMES:
+                        hit(a.name.split(".")[-1], node.lineno)
+            elif isinstance(node, ast.Attribute):
+                if node.attr in WIRING_NAMES:
+                    hit(node.attr, node.lineno)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver + mechanical fixes.
 
 def run_src_lint(repo_root: str,
@@ -683,6 +803,8 @@ def run_src_lint(repo_root: str,
         findings += check_clock_seam(repo_root, package, mods)
     if "keep-in-sync" in active:
         findings += check_keep_in_sync(repo_root)
+    if "engine-owns-wiring" in active:
+        findings += check_engine_owns_wiring(repo_root, package, mods)
     findings.sort(key=lambda f: (f.rule, f.path, f.line))
     return findings
 

@@ -1,0 +1,22 @@
+"""engine/ — the one replicated-execution front-end (ROADMAP direction
+4; arXiv:1902.00465).  ``spec`` is the declarative half (RunSpec, the
+MODES registry, the pure mode/layout resolvers — stdlib-only, importable
+from jax-free tools); ``engine`` is the executing half (the Engine
+itself).  The Engine is exported lazily so ``engine.spec`` consumers
+(tools/obs_query.py) never pay — or break on — a jax import.
+"""
+
+from distributedtensorflowexample_tpu.engine.spec import (
+    MODES, ModeDecl, RunSpec, resolve_mode, resolve_update_layout)
+
+__all__ = ["MODES", "ModeDecl", "RunSpec", "resolve_mode",
+           "resolve_update_layout", "Engine", "EngineBuild",
+           "apply_update_layout", "auto_steps_per_loop"]
+
+
+def __getattr__(name):
+    if name in ("Engine", "EngineBuild", "apply_update_layout",
+                "auto_steps_per_loop"):
+        from distributedtensorflowexample_tpu.engine import engine as _eng
+        return getattr(_eng, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

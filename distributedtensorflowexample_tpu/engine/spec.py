@@ -1,0 +1,144 @@
+# graftlint: stdlib-only
+"""The declarative half of engine/ — what a workload SAYS, with no jax
+in sight (arXiv:1902.00465's input_fn/model_fn split, grown a knob
+surface).
+
+A :class:`RunSpec` is the whole declaration: a model (registry name or
+``model_fn``), a dataset family (or ``input_fn``), the parsed
+:class:`~distributedtensorflowexample_tpu.config.RunConfig`, and
+nothing else.  Everything that used to be hand-forked per trainer —
+mesh construction, replication-mode selection, collective insertion,
+the rows/constraint state layouts, the checkpoint/obs/ledger/heal/
+heartbeat hook stack — is the Engine's job (engine/engine.py).
+
+The MODES table is the registry the tentpole exists for: each
+replication strategy DECLARES its update layout and its graftlint HLO
+contract here, so "add a mode" means "add a row + a contract", not
+"fork the wiring a seventh time".  ``resolve_mode`` /
+``resolve_update_layout`` are pure functions of (config, mesh_size) —
+the same resolution run_training always applied, now callable from
+stdlib-only tools (tools/obs_query.py renders a ledger row's layout
+through them without importing jax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModeDecl:
+    """One replication strategy: its checkpoint-layout contract and the
+    HLO contract (``module:ATTR``) graftlint holds its compiled step
+    to.  ``contract`` is a dotted reference, not the dict itself — spec
+    stays importable without jax; analysis/hlo_lint.py resolves it."""
+
+    name: str
+    update_layout: str              # tree | bucket_rows | zero3_rows
+    contract: Optional[str]         # "pkg.module:ATTR" or None (async:
+                                    # the cond-gated worker average has
+                                    # no fixed per-step multiset to pin)
+    summary: str
+
+
+_P = "distributedtensorflowexample_tpu.parallel"
+
+#: The mode registry — ordered from plainest to most sharded; the
+#: resolution below picks the FIRST row whose knobs are live.
+MODES = {
+    "sync_dp": ModeDecl(
+        "sync_dp", "tree", f"{_P}.sync:HLO_CONTRACT",
+        "sync data-parallel: per-parameter gradient psum each step "
+        "(covers --shard_update's GSPMD constraint form: same program "
+        "shape, optimizer state laid out 1/D)"),
+    "async_ps": ModeDecl(
+        "async_ps", "tree", None,
+        "async-PS emulation: worker-tiled state, local SGD, "
+        "cond-gated parameter average every --async_period steps"),
+    "bucketed": ModeDecl(
+        "bucketed", "tree", f"{_P}.bucketing:BUCKETED_HLO_CONTRACT",
+        "--bucket_grads: per-parameter all-reduces fused into "
+        "knee-sized dtype-homogeneous buckets"),
+    "zero1": ModeDecl(
+        "zero1", "bucket_rows", f"{_P}.bucketing:ZERO1_HLO_CONTRACT",
+        "--bucket_grads + --shard_update: explicit per-bucket "
+        "reduce-scatter -> sharded update -> all-gather; optimizer "
+        "state resident as 1/D bucket rows"),
+    "zero3": ModeDecl(
+        "zero3", "zero3_rows", f"{_P}.zero3:HLO_CONTRACT",
+        "--shard_params (ZeRO-3/FSDP): params, grads AND optimizer "
+        "state as 1/D bucket rows; per-bucket all-gather just before "
+        "use"),
+}
+
+
+def _get(config, key: str, default=None):
+    """Read a knob off a RunConfig OR a plain dict (ledger run_start
+    rows carry the config as a dict)."""
+    if isinstance(config, dict):
+        return config.get(key, default)
+    return getattr(config, key, default)
+
+
+def resolve_mode(config, mesh_size: int) -> ModeDecl:
+    """The one mode-selection function (the exact cascade run_training
+    applied inline): which MODES row this (config, mesh) resolves to.
+    Pure and stdlib-only — no validation here (the Engine refuses bad
+    knob combinations by name before ever calling this)."""
+    bucket_on = bool(_get(config, "bucket_grads", ""))
+    sync = _get(config, "sync_mode", "sync") == "sync"
+    if not sync:
+        return MODES["async_ps"]
+    if mesh_size > 1 and bucket_on and _get(config, "shard_params", False):
+        return MODES["zero3"]
+    if mesh_size > 1 and bucket_on and _get(config, "shard_update", False):
+        return MODES["zero1"]
+    if mesh_size > 1 and bucket_on:
+        return MODES["bucketed"]
+    return MODES["sync_dp"]
+
+
+def resolve_update_layout(config, mesh_size: int) -> str:
+    """The checkpoint layout contract of a (config, mesh) pair — what
+    run_meta["update_layout"] records and cross-layout resume refusals
+    compare.  Callable on a raw ledger config dict (tools/obs_query.py
+    diff renders it per run)."""
+    return resolve_mode(config, mesh_size).update_layout
+
+
+@dataclasses.dataclass
+class RunSpec:
+    """A workload, declared.  ``model``/``dataset`` are the registry
+    names every reference trainer already used; the three optional
+    callables are the TF-Replicator seams for workloads the registries
+    don't know:
+
+    * ``model_fn(cfg) -> flax module`` — replaces the models registry
+      lookup (the ~50-line demo ships its own module inline).
+    * ``input_fn(cfg, split) -> (x, y)`` — replaces the dataset-family
+      loader (and its --dataset source matching), e.g. the bench's
+      fallback-source loads or the demo's toy blobs.
+    * ``optimizer_fn(cfg, mesh, wrap_shard_update) -> optax tx`` —
+      replaces build_optimizer for callers whose optimizer is not the
+      flag surface's (the bench pins a bare float-LR optax.sgd: a
+      schedule-wrapped twin has a DIFFERENT opt_state pytree, and the
+      bench's parity contract is bitwise).
+
+    ``token_data=None`` derives the integer-split contract from the
+    family name (the lm corpus), exactly as run_training did.
+    """
+
+    model: str
+    dataset: str
+    config: Any
+    augment: bool = False
+    model_fn: Optional[Callable] = None
+    input_fn: Optional[Callable] = None
+    optimizer_fn: Optional[Callable] = None
+    token_data: Optional[bool] = None
+
+    def resolved_token_data(self) -> bool:
+        if self.token_data is not None:
+            return bool(self.token_data)
+        return self.dataset == "lm"

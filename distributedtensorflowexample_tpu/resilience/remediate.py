@@ -86,6 +86,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob as _glob
+import json
 import math
 import os
 import re
@@ -101,7 +102,7 @@ from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
 # take, written with src="heal" plus a "job" scope field.
 # tools/obs_query.py's `why` verb renders exactly this set — the reader
 # and this writer must not drift.
-# KEEP-IN-SYNC(heal-events) digest=0b62c0ca8c20
+# KEEP-IN-SYNC(heal-events) digest=28d0c1dcec37
 HEAL_EVENTS = (
     "heal_detect",            # anomaly folded into the policy engine
     "heal_evict",             # loss-free gang stop (TERM→143→resume)
@@ -112,6 +113,7 @@ HEAL_EVENTS = (
     "heal_canary_rollback",   # canary regressed: reverted to baseline
     "heal_scale_up",          # serve fleet grown against the SLO knee
     "heal_scale_down",        # serve fleet shrunk (sustained underload)
+    "heal_lr_drop",           # plateau -> LR-drop advisory (HEAL_LR_DROP)
     "heal_suppressed",        # guardrail suppressed an action (with why)
     "heal_dry_run",           # dry-run: what WOULD have fired
     "heal_budget_exhausted",  # budget gone: detection-only from here on
@@ -121,7 +123,7 @@ HEAL_EVENTS = (
 #: Actions (the ``heal_<action>`` applied-row suffixes).
 HEAL_ACTIONS = ("evict", "rollback", "slo_tighten", "quarantine",
                 "canary_promote", "canary_rollback",
-                "scale_up", "scale_down")
+                "scale_up", "scale_down", "lr_drop")
 
 _DETECTIONS = obs_metrics.counter(
     "heal_detections_total", "anomaly detections folded into the "
@@ -156,6 +158,61 @@ def cooldown_default() -> float:
     """``HEAL_COOLDOWN_S``: per-(kind, scope) quiet period after an
     action (default 30 s)."""
     return _env_float("HEAL_COOLDOWN_S", 30.0)
+
+
+def lr_drop_enabled() -> bool:
+    """``HEAL_LR_DROP``: 1/true = map ``loss_plateau`` to the lr-drop
+    advisory stub instead of gang rollback (experimental: the trainer
+    consumption seam is not wired yet — the actuator writes an advisory
+    file a future LR hook reads at its next consensus poll)."""
+    return str(os.environ.get("HEAL_LR_DROP", "")).lower() in (
+        "1", "true", "t", "yes", "y")
+
+
+def newest_heal_record(root: str = "") -> str:
+    """Path of the newest checked-in MTTR drill record
+    (``HEAL_*_r<NN>.json`` at the repo root — round number sorts
+    lexicographically), or ``""`` when none exists."""
+    if not root:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    paths = sorted(_glob.glob(os.path.join(root, "HEAL_*.json")))
+    return paths[-1] if paths else ""
+
+
+def mttr_seeded_cooldown_s(record_path: str = "", *, margin: float = 2.0,
+                           floor_s: float = 5.0) -> float:
+    """Cooldown seeded from MEASURED recovery time instead of a
+    hardcoded constant: ``margin ×`` the worst end-to-end MTTR the
+    newest ``HEAL_*`` drill record proved (detect → act → resumed), so
+    the post-action quiet period holds exactly as long as a real heal
+    plausibly takes.  A 30 s constant was simultaneously too short for
+    a 21 s slow-rank evict+resume and absurdly long for a 54 ms SLO
+    tighten; anchoring on the measured tail keeps the guardrail honest
+    as the fleet's recovery speed changes.  ``HEAL_COOLDOWN_S`` (via
+    :func:`cooldown_default`) still wins when no record is readable."""
+    path = record_path or newest_heal_record()
+    worst_ms = 0.0
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if str(row.get("metric", "")).endswith("_mttr_ms"):
+                    try:
+                        worst_ms = max(worst_ms, float(row["value"]))
+                    except (KeyError, TypeError, ValueError):
+                        continue
+    except OSError:
+        return cooldown_default()
+    if worst_ms <= 0:
+        return cooldown_default()
+    return max(floor_s, margin * worst_ms / 1000.0)
 
 
 def budget_default() -> int:
@@ -328,6 +385,11 @@ class Remediator:
         self.ledger_path = ledger_path
         self.actuators = dict(actuators or {})
         self.policy = dict(DEFAULT_POLICY if policy is None else policy)
+        if policy is None and lr_drop_enabled():
+            # Experimental (HEAL_LR_DROP): a plateau asks for a smaller
+            # LR before it asks for a rollback — the advisory stub;
+            # explicit policy tables are never silently rewritten.
+            self.policy["loss_plateau"] = HealRule("lr_drop")
         self.scope = scope
         self.dry_run = dry_run_default() if dry_run is None else dry_run
         self.guardrails = guardrails or Guardrails(clock=clock)
@@ -843,6 +905,32 @@ def make_slo_actuator(get_slo, set_slo, target_ms: float):
         return {"slo_ms": new, "was": current,
                 "p99_ms": ev.detail.get("p99_ms")}
     return tighten
+
+
+def make_lr_drop_actuator(advisory_path: str, factor: float = 0.5):
+    """Plateau → LR-drop advisory (stub, behind ``HEAL_LR_DROP``): no
+    live trainer seam consumes this yet, so the actuator's whole effect
+    is one advisory file — ``{"scale", "fired_step", "kind"}`` — that a
+    future LR hook reads at its next consensus poll, plus the
+    ``heal_lr_drop`` ledger row.  Idempotent: rewriting the same
+    advisory is a no-op in effect; repeated plateaus compound the scale
+    so each action asks for a genuinely smaller LR."""
+    def lr_drop(ev: AnomalyEvent) -> dict:
+        prior = 1.0
+        try:
+            with open(advisory_path, encoding="utf-8") as f:
+                prior = float((json.load(f) or {}).get("scale", 1.0))
+        except (OSError, ValueError):
+            pass
+        scale = prior * factor
+        tmp = advisory_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"scale": scale, "fired_step": ev.step,
+                       "kind": ev.kind}, f)
+        os.replace(tmp, advisory_path)
+        return {"advisory": advisory_path, "scale": scale,
+                "factor": factor, "stub": True}
+    return lr_drop
 
 
 def make_autoscale_actuator(get_replicas, set_replicas, *,

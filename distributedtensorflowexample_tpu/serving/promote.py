@@ -93,10 +93,10 @@ def _template(model, tx, layout: str, meta: dict, sample_len: int):
             f"layout-stamping writer")
     import jax
 
+    from distributedtensorflowexample_tpu.engine.engine import (
+        apply_update_layout)
     from distributedtensorflowexample_tpu.parallel import (
         make_mesh, replicated_sharding)
-    from distributedtensorflowexample_tpu.parallel.bucketing import (
-        init_bucketed_opt_state)
     if mesh_size > len(jax.devices()):
         raise ModeRefusal(
             f"snapshot was written at mesh_size {mesh_size} "
@@ -106,15 +106,17 @@ def _template(model, tx, layout: str, meta: dict, sample_len: int):
     mesh = make_mesh(int(mesh_size))
     # The row converters shard across the mesh; the template's params
     # must live ON it first (TrainState.create places single-device).
+    # The re-layout itself is the Engine's shared pass — the one the
+    # snapshot writer ran — so the row geometry can't drift.
     repl = jax.device_put(base.params, replicated_sharding(mesh))
-    opt = init_bucketed_opt_state(tx, repl, int(bucket_bytes), mesh)
+    rowed, z3 = apply_update_layout(
+        base.replace(params=repl), tx, update_layout=layout,
+        bucket_bytes=int(bucket_bytes), mesh=mesh)
     if layout == "bucket_rows":
-        return base.replace(opt_state=opt), None
-    from distributedtensorflowexample_tpu.parallel.zero3 import (
-        Zero3Layout)
-    z3 = Zero3Layout(repl, int(bucket_bytes), mesh)
-    # init_rows DONATES its input; opt was built from the tree first.
-    return base.replace(opt_state=opt, params=z3.init_rows(repl)), z3
+        # Params stay the single-device create() tree: only the
+        # optimizer state is row-shaped in a ZeRO-1 snapshot.
+        return base.replace(opt_state=rowed.opt_state), None
+    return base.replace(opt_state=rowed.opt_state, params=rowed.params), z3
 
 
 def promote(snapshot_dir: str, size: str, *, step: int | None = None,

@@ -96,6 +96,14 @@ class SimWorld:
         if not serve:
             return
         knee = float(serve["knee_per_replica"])
+        # Cooldown default: seeded from the newest measured HEAL_*
+        # MTTR record (2x the worst proven detect->recovered tail)
+        # rather than a hardcoded constant — a scenario that names
+        # cooldown_s still wins, and the seed is deterministic (the
+        # record is checked in), so same-seed runs stay bitwise.
+        cooldown_s = serve.get("cooldown_s")
+        if cooldown_s is None:
+            cooldown_s = heal_mod.mttr_seeded_cooldown_s()
         self.traffic = TrafficModel(
             self.clock, replicas=int(serve.get("replicas", 1)),
             knee_per_replica=knee)
@@ -121,7 +129,7 @@ class SimWorld:
             guardrails=heal_mod.Guardrails(
                 flap_n=serve.get("flap_n"),
                 flap_window_s=serve.get("flap_window_s"),
-                cooldown_s=serve.get("cooldown_s"),
+                cooldown_s=cooldown_s,
                 budget=serve.get("budget"),
                 clock=self.clock.wall))
         watcher = heal_mod.AutoscaleWatcher(

@@ -20,8 +20,10 @@ Schema (all times in virtual seconds from sim start)::
         "min_replicas": 1, "max_replicas": 16, "poll_s": 5.0,
         "headroom": 0.85, "low_water": 0.35,
         "flap_n": 2, "flap_window_s": 60, "cooldown_s": 60,
-        "budget": 8
-      },
+        "budget": 8                   # cooldown_s omitted -> seeded
+      },                              # from the measured HEAL_* MTTR
+                                      # record (remediate.
+                                      # mttr_seeded_cooldown_s)
       "events": [                     # the scripted world
         {"at": 120, "kind": "host_loss", "job": "t1", "rank": 3}, ...
       ]

@@ -31,8 +31,8 @@ import argparse
 import sys
 
 from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.engine import Engine, RunSpec
 from distributedtensorflowexample_tpu.models import LM_SIZES
-from distributedtensorflowexample_tpu.trainers.common import run_training
 
 
 def main(argv=None) -> dict:
@@ -55,7 +55,7 @@ def main(argv=None) -> dict:
         # defaults.
         overrides.update(remat="block", bucket_grads="auto")
     cfg = parse_flags(rest, description=__doc__, **overrides)
-    return run_training(cfg, model_name=ns.size, dataset_name="lm")
+    return Engine(RunSpec(model=ns.size, dataset="lm", config=cfg)).run()
 
 
 if __name__ == "__main__":

@@ -11,14 +11,15 @@ from __future__ import annotations
 import sys
 
 from distributedtensorflowexample_tpu.config import parse_flags
-from distributedtensorflowexample_tpu.trainers.common import run_training
+from distributedtensorflowexample_tpu.engine import Engine, RunSpec
 
 
 def main(argv=None) -> dict:
     cfg = parse_flags(argv, description=__doc__,
                       batch_size=100, train_steps=1000, learning_rate=0.5,
                       num_devices=1, dataset="mnist")
-    return run_training(cfg, model_name="softmax", dataset_name="mnist")
+    return Engine(RunSpec(model="softmax", dataset="mnist",
+                          config=cfg)).run()
 
 
 if __name__ == "__main__":

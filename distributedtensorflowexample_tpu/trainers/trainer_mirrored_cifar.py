@@ -12,7 +12,7 @@ from __future__ import annotations
 import sys
 
 from distributedtensorflowexample_tpu.config import parse_flags
-from distributedtensorflowexample_tpu.trainers.common import run_training
+from distributedtensorflowexample_tpu.engine import Engine, RunSpec
 
 
 def main(argv=None) -> dict:
@@ -20,8 +20,8 @@ def main(argv=None) -> dict:
                       batch_size=128, train_steps=5000, learning_rate=0.1,
                       momentum=0.9, weight_decay=1e-4, lr_schedule="step",
                       warmup_steps=200, dataset="cifar10")
-    return run_training(cfg, model_name="resnet20", dataset_name="cifar10",
-                        augment=True)
+    return Engine(RunSpec(model="resnet20", dataset="cifar10",
+                          config=cfg, augment=True)).run()
 
 
 if __name__ == "__main__":

@@ -20,14 +20,15 @@ from __future__ import annotations
 import sys
 
 from distributedtensorflowexample_tpu.config import parse_flags
-from distributedtensorflowexample_tpu.trainers.common import run_training
+from distributedtensorflowexample_tpu.engine import Engine, RunSpec
 
 
 def main(argv=None) -> dict:
     cfg = parse_flags(argv, description=__doc__,
                       batch_size=64, train_steps=2000, learning_rate=0.05,
                       momentum=0.9, dataset="mnist", sync_mode="async")
-    return run_training(cfg, model_name="mnist_cnn", dataset_name="mnist")
+    return Engine(RunSpec(model="mnist_cnn", dataset="mnist",
+                          config=cfg)).run()
 
 
 if __name__ == "__main__":

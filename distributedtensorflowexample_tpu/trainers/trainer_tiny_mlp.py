@@ -1,0 +1,55 @@
+"""The Engine payoff demo (DESIGN.md §26): a brand-new workload in
+~50 lines.  No wiring — a TinyMLP module, a blob input_fn, and a
+RunSpec; the Engine supplies the mesh, replication mode, collectives,
+checkpointing, supervision, and telemetry the six reference trainers
+share, so ``--sync_mode``, ``--bucket_grads``, SIGTERM preemption →
+resume, and the obs ledger all work here unchanged.
+
+  python -m distributedtensorflowexample_tpu.trainers.trainer_tiny_mlp \
+      --train_steps 200
+"""
+
+from __future__ import annotations
+
+import sys
+
+import flax.linen as nn
+
+from distributedtensorflowexample_tpu.config import parse_flags
+from distributedtensorflowexample_tpu.data.synthetic import make_synthetic
+from distributedtensorflowexample_tpu.engine import Engine, RunSpec
+
+NUM_CLASSES = 4
+FEATURES = (8, 8, 1)     # image-shaped so the shared eval path applies
+
+
+class TinyMLP(nn.Module):
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, name="hidden")(x))
+        return nn.Dense(NUM_CLASSES, name="logits")(x)
+
+
+def blobs(cfg, split):
+    """Deterministic learnable blobs; train/test share templates
+    (seed) and differ in draws (sample_seed) so accuracy generalizes."""
+    return make_synthetic(4096 if split == "train" else 512, FEATURES,
+                          NUM_CLASSES, seed=cfg.seed,
+                          sample_seed=cfg.seed + (split == "test"))
+
+
+def main(argv=None) -> dict:
+    cfg = parse_flags(argv, description=__doc__, batch_size=32,
+                      train_steps=300, learning_rate=0.1, momentum=0.9,
+                      dataset="tiny_blobs", dropout=0.0)
+    spec = RunSpec(model="tiny_mlp", dataset="tiny_blobs", config=cfg,
+                   model_fn=lambda cfg: TinyMLP(), input_fn=blobs)
+    return Engine(spec).run()
+
+
+if __name__ == "__main__":
+    summary = main(sys.argv[1:])
+    print(f"final accuracy: {summary.get('final_accuracy', float('nan')):.4f}")

@@ -19,6 +19,10 @@ ISOLATED_FILES = [
     "test_dequant.py",      # bitwise parity runs = fused training loops
     "test_determinism.py",
     "test_device_data.py",
+    "test_engine.py",       # per-mode bitwise Engine-vs-raw-wiring
+                            # parity: full fused training tapes over the
+                            # 8-device mesh in every replication mode
+
     "test_fleet_drill.py",  # N-rank gang drills: each rank a fresh jax
                             # subprocess — isolated for wall time, not
                             # collective-abort risk (the fast stdlib-child

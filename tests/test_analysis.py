@@ -279,6 +279,47 @@ def test_keep_in_sync_unpaired_and_unterminated(tmp_path):
     assert "keep-in-sync:never:unterminated" in keys
 
 
+# --- engine-owns-wiring -----------------------------------------------------
+
+def test_engine_owns_wiring_rule(tmp_path):
+    """Raw step-wiring names outside engine/ and parallel/ fire — from
+    module- AND function-level imports (lazy wiring is still wiring)
+    and bare attribute references — while engine/, parallel/, and
+    docstring prose stay clean, and tools/ scripts are in scope."""
+    root = _seed(tmp_path, {
+        "seedpkg/trainers/__init__.py": "",
+        "seedpkg/trainers/bad.py": """\
+            def build():
+                from seedpkg.parallel.zero3 import Zero3Layout
+                return Zero3Layout
+        """,
+        "seedpkg/serving/__init__.py": "",
+        "seedpkg/serving/attr.py": """\
+            import jax
+
+            def f(x):
+                return jax.shard_map(x)
+        """,
+        "seedpkg/engine/__init__.py": "",
+        "seedpkg/engine/engine.py":
+            "from seedpkg.parallel.sync import make_train_step\n",
+        "seedpkg/parallel/__init__.py": "",
+        "seedpkg/parallel/sync.py": "def make_train_step():\n    pass\n",
+        "seedpkg/docs_only.py":
+            '"""Prose may mention make_train_step and shard_map."""\n',
+        "tools/wired.py":
+            "from seedpkg.parallel.sync import make_train_step\n",
+    })
+    keys = _keys(src_lint.run_src_lint(root, "seedpkg",
+                                       rules=("engine-owns-wiring",)))
+    assert ("engine-owns-wiring:seedpkg/trainers/bad.py:Zero3Layout"
+            in keys)
+    assert "engine-owns-wiring:seedpkg/serving/attr.py:shard_map" in keys
+    assert "engine-owns-wiring:tools/wired.py:make_train_step" in keys
+    assert not any("engine/" in k or "parallel/" in k or "docs_only" in k
+                   for k in keys)
+
+
 # --- waiver machinery -------------------------------------------------------
 
 def test_waiver_validation_staleness_and_budget(tmp_path):

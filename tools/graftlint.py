@@ -14,6 +14,10 @@ Source front (analysis/src_lint.py — stdlib-only, no jax import):
                   the obs/metrics.py _now/_wall seam
   keep-in-sync    paired KEEP-IN-SYNC digest markers agree with their
                   regions' current content
+  engine-owns-wiring  raw step-wiring names (parallel/ step builders,
+                  worker/opt-state re-layout ctors, shard_map) appear
+                  only under engine/ and parallel/ — everywhere else
+                  a workload is a RunSpec (allowlist in src_lint)
 
 HLO front (analysis/hlo_lint.py — compiles the per-mode softmax suite
 on a CPU mesh plus the serving decode step, then checks each module

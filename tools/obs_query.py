@@ -45,6 +45,8 @@ for _p in (_REPO, os.path.dirname(os.path.abspath(__file__))):
     if _p not in sys.path:
         sys.path.insert(0, _p)
 
+from distributedtensorflowexample_tpu.engine import (  # noqa: E402
+    resolve_update_layout)     # stdlib-only half of engine/ (spec.py)
 from distributedtensorflowexample_tpu.obs import ledger as obs_ledger  # noqa: E402
 from obs_report import _table as _table_lines  # noqa: E402  (tools/)
 
@@ -149,6 +151,22 @@ def diff_runs(folded: dict, id_a: str, id_b: str) -> dict:
     config_diff = {k: {"a": cfg(a).get(k), "b": cfg(b).get(k)}
                    for k in keys if cfg(a).get(k) != cfg(b).get(k)}
 
+    def layout(g):
+        # The DERIVED working layout (tree / bucket_rows / zero3_rows)
+        # — the resume-contract fact the raw knob columns only imply:
+        # two runs can differ in bucket_grads/shard_* strings yet land
+        # in the same layout, or agree on most knobs and still be
+        # checkpoint-incompatible.  Same resolution the Engine runs
+        # (engine/spec.py), from the run's resolved config + mesh_size.
+        start = g["start"] or {}
+        if not start.get("config"):
+            return None
+        try:
+            return resolve_update_layout(start["config"],
+                                         int(start.get("mesh_size") or 1))
+        except Exception:       # noqa: BLE001 — a foreign config shape
+            return None         # must read as "underivable", never die
+
     def counters(g):
         return ((g["end"] or {}).get("counters") or {})
 
@@ -176,6 +194,7 @@ def diff_runs(folded: dict, id_a: str, id_b: str) -> dict:
                               for f in ("entrypoint", "config_digest",
                                         "rank", "attempt")}},
         "config_diff": config_diff,
+        "update_layout": {"a": layout(a), "b": layout(b)},
         "outcome": {"a": {"rc": end_field(a, "rc"),
                           "final_step": end_field(a, "final_step")},
                     "b": {"rc": end_field(b, "rc"),
@@ -210,12 +229,21 @@ def cmd_diff(args) -> int:
                   + ("IDENTICAL (tail digests match)" if same
                      else "differs (tail digests disagree)"))
     md += ["", "## Config diff", ""]
-    if d["config_diff"]:
+    # The derived working layout leads the table for both runs even
+    # when equal: it is the checkpoint-resume contract, and "both
+    # zero3_rows" vs "both tree" changes how every knob row below
+    # reads.
+    lay = d["update_layout"]
+    layout_rows = ([["update_layout (derived)", lay["a"], lay["b"]]]
+                   if lay["a"] or lay["b"] else [])
+    if d["config_diff"] or layout_rows:
         md.append(_table(["key", "a", "b"],
-                         [[k, v["a"], v["b"]]
-                          for k, v in sorted(d["config_diff"].items())]))
-    else:
-        md.append("- identical resolved configs "
+                         layout_rows
+                         + [[k, v["a"], v["b"]]
+                            for k, v in sorted(d["config_diff"].items())]))
+    if not d["config_diff"]:
+        md.append(("" if not layout_rows else "\n")
+                  + "- identical resolved configs "
                   f"(digest {d['a']['config_digest']})")
     md += ["", "## Counter deltas (b - a)", ""]
     if d["counter_deltas"]:
@@ -296,7 +324,7 @@ _TERMINAL_WHY = {"sched_done": "completed", "sched_fail": "failed",
 # Renderers for the remediation engine's heal_* ledger rows — one entry
 # per decision class resilience/remediate.py can write; unknown heal_*
 # rows render generically (same contract as the sched_* table above).
-# KEEP-IN-SYNC(heal-events) digest=0b62c0ca8c20
+# KEEP-IN-SYNC(heal-events) digest=28d0c1dcec37
 _HEAL_RENDER = {
     "heal_detect": lambda r: (
         f"anomaly detected: {r.get('kind')}"
@@ -326,6 +354,10 @@ _HEAL_RENDER = {
     "heal_scale_down": lambda r: (
         f"SCALED DOWN ({r.get('kind')}): serve fleet shrunk — "
         f"sustained underload ({r.get('detail')})"),
+    "heal_lr_drop": lambda r: (
+        f"LR-DROP advisory written ({r.get('kind')}): plateau asks for "
+        f"a smaller LR before a rollback — stub behind HEAL_LR_DROP "
+        f"({r.get('detail')})"),
     "heal_suppressed": lambda r: (
         f"action {r.get('action')} on {r.get('kind')} SUPPRESSED by "
         f"guardrail: {r.get('reason')}"),

@@ -55,6 +55,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from distributedtensorflowexample_tpu.obs import ledger as obs_ledger  # noqa: E402
+from distributedtensorflowexample_tpu.resilience import (  # noqa: E402
+    remediate as heal_mod)
 from distributedtensorflowexample_tpu.sim import (  # noqa: E402
     SimWorld, load_scenario, sim_metrics)
 
@@ -79,8 +81,13 @@ def battery_scenarios() -> list[dict]:
     """Four storms against 10,000 simulated ranks on a 4-slice mesh:
     a host-loss wave, a straggler epidemic, a serve-traffic spike, and
     a quarantine cascade.  Deterministic by construction — everything
-    below is literal."""
+    below is literal except the serve cooldown, which seeds from the
+    CHECKED-IN measured-MTTR record (same bytes every run)."""
     slices = {"podA": 2600, "podB": 2600, "podC": 2600, "podD": 2600}
+    # Post-action quiet period anchored on the worst measured recovery
+    # tail (HEAL_* record) instead of the old hardcoded 60 s — see
+    # remediate.mttr_seeded_cooldown_s.
+    cooldown_s = heal_mod.mttr_seeded_cooldown_s()
 
     def fleet_jobs(tag, *, n=24, steps=1200, elastic=True):
         return [
@@ -143,7 +150,7 @@ def battery_scenarios() -> list[dict]:
         "serve": {"replicas": 2, "knee_per_replica": SERVE_KNEE_TOK_S,
                   "min_replicas": 1, "max_replicas": 8, "poll_s": 5.0,
                   "flap_n": 2, "flap_window_s": 120,
-                  "cooldown_s": 60, "budget": 12},
+                  "cooldown_s": cooldown_s, "budget": 12},
         "events": [
             {"at": 300, "kind": "serve_load",
              "offered_per_s": 4 * SERVE_KNEE_TOK_S},     # spike: 4 knees
