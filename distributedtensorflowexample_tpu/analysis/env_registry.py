@@ -166,6 +166,23 @@ ENV_REGISTRY: dict[str, str] = {
         "simulated gangs when the scenario's per-job sim knobs don't "
         "script one — stretch it to drill slow-drain eviction windows "
         "(sim/fleet.py; default 1.0)."),
+    "SNAPSHOT_DIR": (
+        "Shard-redundant snapshot directory the engine wires a "
+        "ShardSnapshotHook + elastic restore into when the update "
+        "layout is a row layout (engine/engine.py; unset = Orbax "
+        "checkpoints only)."),
+    "SNAPSHOT_IO_BACKOFF_S": (
+        "First retry backoff for a failed shard-payload write, "
+        "doubling per retry (resilience/shardstore.py; default 0.05)."),
+    "SNAPSHOT_IO_RETRIES": (
+        "Bounded retries per shard-payload write before the save "
+        "raises (resilience/shardstore.py; default 2)."),
+    "SNAPSHOT_REDUNDANCY": (
+        "Copies of every shard in a shard-redundant snapshot set: 1 "
+        "own + R-1 ring mirrors, so any R-1 shard losses reconstruct "
+        "and R refuse loudly (resilience/shardstore.py, mirrored by "
+        "the sim's snapshot_loss world model in sim/fleet.py; "
+        "default 2)."),
     "SUPERVISE_ATTEMPT": (
         "Attempt number of the supervised child, exported by the "
         "supervisor so obs rows carry retry provenance (obs/*)."),

@@ -384,6 +384,8 @@ class Engine:
         if cfg.checkpoint_every > 0 or cfg.resume:
             if cfg.checkpoint_every > 0:
                 hooks.append("CheckpointHook")
+        if os.environ.get("SNAPSHOT_DIR", "") and (zero3_on or bucket_zero1):
+            hooks.append("ShardSnapshotHook")
         if cfg.eval_every > 0:
             hooks.append("EvalHook")
         if cfg.profile_dir:
@@ -599,12 +601,48 @@ class Engine:
                 f"per-shard statistics (a different model, not a "
                 f"different collective schedule). Use the default fused "
                 f"all-reduce for BatchNorm models")
-        state, zero3_layout = apply_update_layout(
-            state, tx,
-            update_layout=("zero3_rows" if zero3_on else
-                           "bucket_rows" if bucket_zero1 else "tree"),
-            bucket_bytes=bucket_bytes, mesh=mesh,
-            shard_update=cfg.shard_update)
+        update_layout = ("zero3_rows" if zero3_on else
+                         "bucket_rows" if bucket_zero1 else "tree")
+        snap_dir = os.environ.get("SNAPSHOT_DIR", "")
+        shard_store = None
+        if snap_dir and update_layout != "tree":
+            # Shard-redundant row-layout snapshots (resilience/
+            # shardstore.py): per-rank 1/D shard files + ring mirrors
+            # under a sha256 quorum manifest.  The layout facts come
+            # from the TREE params — they are what the manifest records,
+            # and they are D-independent, which is what makes the
+            # elastic restore below legal.
+            from distributedtensorflowexample_tpu.resilience.shardstore \
+                import ShardLayout, ShardSnapshotHook, ShardStore
+            shard_store = ShardStore(
+                snap_dir,
+                layout=ShardLayout.for_params(update_layout, bucket_bytes,
+                                              state.params, num_replicas),
+                keep=cfg.keep_checkpoints)
+        restored_from_shards = False
+        if shard_store is not None and cfg.resume \
+                and shard_store.latest_valid() is not None:
+            # The engine-integrated ELASTIC restore: a quorum-valid
+            # shard set written at ANY mesh width regroups onto this
+            # one THROUGH the same apply_update_layout pass the
+            # non-resume path runs — bitwise (tests/test_checkpoint.py).
+            # The by-name cross-width refusal in
+            # _refuse_incompatible_restore still guards the Orbax path,
+            # where no regroup exists.
+            state, shard_aux = shard_store.restore_elastic(
+                state, tx, mesh=mesh)
+            zero3_layout = shard_aux["zero3_layout"]
+            restored_from_shards = True
+            if jax.process_index() == 0:
+                print(f"resumed from shard set at step "
+                      f"{shard_aux['step']} (written at "
+                      f"D={shard_aux['from_ranks']}, this mesh is "
+                      f"D={num_replicas})", flush=True)
+        else:
+            state, zero3_layout = apply_update_layout(
+                state, tx, update_layout=update_layout,
+                bucket_bytes=bucket_bytes, mesh=mesh,
+                shard_update=cfg.shard_update)
 
         is_async = cfg.sync_mode == "async"
         if is_async and cfg.replicas_to_aggregate:
@@ -645,7 +683,8 @@ class Engine:
                                         max_to_keep=cfg.keep_checkpoints,
                                         async_save=cfg.async_checkpoint,
                                         run_metadata=run_meta)
-            if cfg.resume and manager.latest_step() is not None:
+            if cfg.resume and not restored_from_shards \
+                    and manager.latest_step() is not None:
                 _refuse_incompatible_restore(manager.saved_run_metadata(),
                                              run_meta, cfg.log_dir,
                                              is_chief)
@@ -655,6 +694,14 @@ class Engine:
                           f"{int(state.step)}", flush=True)
             if cfg.checkpoint_every > 0:
                 hooks.append(CheckpointHook(manager, cfg.checkpoint_every))
+        if shard_store is not None:
+            # Rides next to (not instead of) the Orbax hook: the shard
+            # set is what the fleet's resume agreement and the elastic
+            # restore read.
+            hooks.append(ShardSnapshotHook(shard_store,
+                                           every=max(1,
+                                                     cfg.checkpoint_every),
+                                           cursor={"seed": cfg.seed}))
 
         # Eval batch must divide across the mesh like the train batch
         # does.

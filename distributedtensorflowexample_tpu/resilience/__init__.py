@@ -10,6 +10,11 @@ Three cooperating pieces, each usable alone:
   manifest (step, optimizer state, RNG key, dataset cursor, crc32), so a
   resume is bitwise-identical to an uninterrupted run and a torn write is
   detected and discarded instead of restored.
+- :mod:`.shardstore` — the row-layout (zero1/zero3) twin: per-rank
+  shard files under a sha256 quorum manifest, ring-mirror redundancy
+  (``SNAPSHOT_REDUNDANCY``), reconstruction of any lost/corrupt shard
+  within redundancy, and the ``apply_update_layout``-backed ELASTIC
+  restore that regroups a D=4 shard set bitwise onto a D=2/D=8 mesh.
 - :mod:`.supervisor` — runs any entrypoint under a heartbeat watchdog
   with exponential backoff + jitter, bounded retries, and a journaled
   priority task queue that survives the supervisor's own death.
@@ -45,6 +50,8 @@ from distributedtensorflowexample_tpu.resilience.remediate import (  # noqa: F40
     make_rollback_actuator, make_slo_actuator, run_remediated)
 from distributedtensorflowexample_tpu.resilience.scheduler import (  # noqa: F401
     Job, Scheduler, load_queue)
+from distributedtensorflowexample_tpu.resilience.shardstore import (  # noqa: F401
+    ShardLayout, ShardSnapshotHook, ShardStore, quorum_valid_steps)
 from distributedtensorflowexample_tpu.resilience.snapshot import (  # noqa: F401
     SnapshotHook, SnapshotStore, newest_common_step, valid_steps)
 from distributedtensorflowexample_tpu.resilience.supervisor import (  # noqa: F401

@@ -74,6 +74,18 @@ kind                models
                     fewer stretch points.  Survives resume: a plan step
                     already passed at restart re-activates the delay
                     (the rank is still slow) instead of dropping it
+``shard_loss``      one rank's shard directory deleted from the newest
+                    shard-redundant snapshot set AFTER the final save
+                    (post-exit, like torn_snapshot) — recovery must
+                    reconstruct the missing shard from its ring mirror
+                    (resilience/shardstore.py); ``%RANK`` names the
+                    MESH-SHARD index inside this process's own store,
+                    not a process rank
+``bitflip``         silent bit rot: one payload byte of one rank's own
+                    shard flipped in place (post-exit) — the sha256
+                    digest must catch it and restore must reconstruct
+                    from the mirror, never silently load the rotten
+                    bytes.  ``%RANK`` = mesh-shard index, as above
 ==================  =====================================================
 
 A plan is addressed by ``(text, num_steps, seed)``: unpinned fault steps
@@ -115,9 +127,15 @@ from distributedtensorflowexample_tpu.training.hooks import (
 
 FAULT_KINDS = ("preemption", "wedge", "nan_loss", "corrupt_batch",
                "torn_snapshot", "heartbeat_flap", "journal_torn", "kill",
-               "slow_rank", "host_loss")
+               "slow_rank", "host_loss", "shard_loss", "bitflip")
 _BATCH_KINDS = ("nan_loss", "corrupt_batch")
-_POST_EXIT_KINDS = ("torn_snapshot", "journal_torn")
+_POST_EXIT_KINDS = ("torn_snapshot", "journal_torn", "shard_loss",
+                    "bitflip")
+# Shard-store faults address a MESH-SHARD index inside one process's own
+# ShardStore (a single process owns all D shard files on a D-device CPU
+# mesh), so %RANK on them must survive FaultPlan.for_rank's process-rank
+# filter.
+_SHARD_KINDS = ("shard_loss", "bitflip")
 
 _INJECTED = obs_metrics.counter(
     "faults_injected_total", "fault-plan specs that fired, by kind")
@@ -166,6 +184,18 @@ NAMED_PLANS = {
     # scheduler's autoscaling policy drills.  Pin others / change the
     # outage length with the grammar (host_loss@N:SECS%RANK).
     "host_loss": [("host_loss", None, 2.0, 1)],
+    # Mesh-shard 1's snapshot directory vanishes after the final save,
+    # paired with a preemption at the same anchor so a supervised run
+    # HAS a next attempt — which must reconstruct the shard from its
+    # ring mirror and resume bitwise (the "any single-rank shard loss"
+    # drill).  Pin another shard with the grammar (shard_loss@N%RANK).
+    "shard_loss": [("shard_loss", None, 0.0, 1),
+                   ("preemption", None, 0.0)],
+    # One payload byte of mesh-shard 1's own file flips after the final
+    # save (silent bit rot); the next attempt's restore must DETECT the
+    # digest mismatch and reconstruct — never silently load rot.
+    "bitflip": [("bitflip", None, 0.0, 1),
+                ("preemption", None, 0.0)],
 }
 
 
@@ -216,8 +246,13 @@ class FaultPlan:
         another rank drop out; unpinned (rank=None) specs apply
         everywhere.  Every rank filters the SAME parsed plan, so the
         shared seed anchor stays identical fleet-wide — 'kill rank 1 at
-        the seed-drawn step' names one step, not one per rank."""
-        keep = [s for s in self.specs if s.rank is None or s.rank == rank]
+        the seed-drawn step' names one step, not one per rank.  Shard-
+        store faults (``_SHARD_KINDS``) are exempt: their %RANK names a
+        mesh-shard index in THIS process's own store, so every process
+        keeps them."""
+        keep = [s for s in self.specs
+                if s.rank is None or s.rank == rank
+                or s.kind in _SHARD_KINDS]
         return FaultPlan(keep, seed=self.seed,
                          name=f"{self.name}[rank {rank}]")
 

@@ -1031,7 +1031,13 @@ class FleetSupervisor:
         surviving rank's store, divergent/torn newer steps discarded
         from disk, result journaled — returns the step to export (0 =
         no common step: fresh start), or None when the run has no
-        snapshot surface to agree over.
+        snapshot surface to agree over.  "Valid" unions both snapshot
+        formats (``snapshot.valid_steps``): a row-layout rank's
+        quorum-valid shard sets (every 1/D shard digest-intact or
+        ring-mirror-recoverable, resilience/shardstore.py) count
+        exactly like monolithic payloads — so a rank that lost one
+        shard directory within redundancy still votes for that step,
+        and the gang does NOT regress past a recoverable save.
 
         The ``resume_agreement`` record is WRITE-AHEAD: it commits the
         agreed step (and what will be discarded) to the journal BEFORE

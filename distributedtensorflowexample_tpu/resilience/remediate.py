@@ -843,6 +843,10 @@ def make_rollback_actuator(snapshot_dir_template: str,
     EVERY rank holds valid that strictly predates the anomaly's
     ``fired_step``), discard everything newer on every rank, and stop
     the gang so the relaunch's agreement pass lands exactly there.
+    "Valid" is ``snapshot.valid_steps`` — monolithic-valid UNION
+    quorum-valid shard sets (resilience/shardstore.py), so a row-layout
+    run rolls back to a step whose every 1/D shard is digest-intact (or
+    ring-mirror-recoverable), and the discard covers both formats.
     Idempotent end to end: ``discard_newer`` finds already-discarded
     steps gone, and re-pinning the same step re-derives the same
     answer."""

@@ -1,0 +1,946 @@
+"""Shard-redundant crash-consistent snapshots for the 1/D row layouts.
+
+``resilience/snapshot.py`` writes ONE monolithic payload per step — the
+right recovery format for a tree-layout run, and exactly the wrong one
+for zero1/zero3 (``--bucket_grads`` / ``--shard_params``): there each
+device owns a 1/D row of every bucket, so a full-state payload both
+gathers state the rank doesn't own and couples every rank's save to one
+file.  This store writes what the layout actually is:
+
+- **per-rank shards**: rank r saves only ITS row of every bucket flat
+  (``own.npz`` under ``shards_<step>/rank_<r>/``) — params rows under
+  zero3, optimizer-moment rows under both row layouts;
+- **ring mirrors** (redundancy R, ``SNAPSHOT_REDUNDANCY``, default 2):
+  rank ``(s+m) % D`` additionally holds a byte-identical copy of rank
+  s's shard for ``m < R`` — so ANY R-1 lost/corrupt rank directories
+  still leave every shard at least one intact copy, and restore
+  reconstructs the missing ones from their mirrors;
+- **replicated leaves** (step, RNG, schedule counts — and the full
+  params tree under zero1, where params stay replicated) land in
+  ``repl.npz`` on ranks ``0..R-1``: the same survive-any-R-1-losses
+  guarantee without D full copies;
+- **quorum manifest, written LAST**: sha256 per shard + the layout
+  facts (mesh width D, bucket plan, param leaf specs, bucket_bytes) —
+  a step is quorum-valid iff every shard and the replicated payload
+  have at least one digest-intact copy.  A write torn anywhere before
+  the manifest rename leaves no manifest and the step reads as absent;
+  a bit flipped after commit fails its sha256 and that COPY is
+  refused, never silently restored.
+
+Every payload write goes through the obs atomic-write discipline
+(tmp + fsync + rename) with bounded retry/backoff on OSError
+(``SNAPSHOT_IO_RETRIES`` / ``SNAPSHOT_IO_BACKOFF_S``) — a flaky disk
+costs retries, a dead one costs ONE snapshot interval, never the run.
+
+Restore comes in two shapes:
+
+- :meth:`ShardStore.restore` — same mesh width only (refused BY NAME
+  across widths: the 1/D row layout is structural), positional
+  row/replicated install into an already-laid-out row state;
+- :meth:`ShardStore.restore_elastic` — any mesh width.  The saved
+  bucket plan is a pure function of the param leaf specs + byte cap
+  (``plan_buckets``), so it is D-independent; only the per-leaf zero
+  padding ``ceil(n/D)`` inside each bucket changes with D.  Elastic
+  restore therefore (1) reassembles each bucket flat from the shards,
+  (2) strips the old padding back to exact leaf values (pure byte
+  moves — numpy twins of ``parallel/bucketing._unbucket_rows``),
+  (3) rebuilds the full param tree and hands it to the engine's ONE
+  re-layout pass (``engine.apply_update_layout``) on the new mesh, and
+  (4) grafts the optimizer-moment rows in with the same regroup.
+  Every move is byte movement around zero padding, so a D=4 shard set
+  restored onto D=2 (or D=8) materializes BITWISE the state the saver
+  held — proven in tests/test_checkpoint.py.
+
+When shard loss exceeds redundancy the restore refuses loudly, naming
+the shard, its copy census, and the knob (``SNAPSHOT_REDUNDANCY``)
+that bounds what is survivable — a half-reconstructed state must never
+train.  Fleet resume agreement and the Remediator's rollback actuator
+see these steps through ``snapshot.valid_steps`` (monolithic-valid ∪
+quorum-valid), so "the newest step the gang can provably agree on"
+already means shard quorum.  Restore/reconstruction events land in the
+run ledger as ``ckpt_*`` rows (rendered by tools/obs_query.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+import sys
+import time
+
+import jax
+import numpy as np
+
+from distributedtensorflowexample_tpu.obs import ledger as obs_ledger
+from distributedtensorflowexample_tpu.obs import metrics as obs_metrics
+from distributedtensorflowexample_tpu.obs import recorder as obs_recorder
+from distributedtensorflowexample_tpu.obs.trace import span
+from distributedtensorflowexample_tpu.parallel.bucketing import plan_buckets
+from distributedtensorflowexample_tpu.refusal import ModeRefusal
+from distributedtensorflowexample_tpu.training.checkpoint import (
+    saveable_state_dict)
+from distributedtensorflowexample_tpu.training.hooks import Hook, _EveryN
+from distributedtensorflowexample_tpu.training.state import TrainState
+
+MANIFEST_VERSION = 1
+_STEP_DIR_RE = re.compile(r"^shards_(\d{8})$")
+
+_SAVES = obs_metrics.counter(
+    "ckpt_shard_saves_total", "committed shard-set writes "
+    "(all rank payloads + manifest)")
+_SAVE_FAILURES = obs_metrics.counter(
+    "ckpt_shard_save_failures", "shard-set writes refused by the OS "
+    "after retries, survived by the run (keep-N covers the gap)")
+_RESTORES = obs_metrics.counter(
+    "ckpt_shard_restores_total", "successful restores from a shard set "
+    "(same-width and elastic)")
+_RECONSTRUCTIONS = obs_metrics.counter(
+    "ckpt_shard_reconstructions_total",
+    "shards rebuilt from a ring mirror (own copy missing or corrupt)")
+_DIGEST_MISMATCHES = obs_metrics.counter(
+    "ckpt_digest_mismatches_total",
+    "shard copies refused by sha256 — bit rot detected, never restored")
+_IO_RETRIES = obs_metrics.counter(
+    "ckpt_io_retries_total", "payload writes retried after an OSError "
+    "(SNAPSHOT_IO_RETRIES bounds the attempts)")
+_REFUSALS = obs_metrics.counter(
+    "ckpt_restore_refusals_total",
+    "restores refused loudly (loss beyond redundancy, width mismatch "
+    "on the non-elastic path, structural drift)")
+
+
+def _log(msg: str) -> None:
+    print(f"shardstore: {msg}", file=sys.stderr, flush=True)
+
+
+def _event(event: str, **fields) -> None:
+    # Gang ranks inherit OBS_PHASE = the gang/job name (resilience/
+    # fleet.py exports it per rank), so stamping it as job= threads
+    # ckpt_* rows into the same per-job `why` timeline obs_query builds
+    # from the sched_*/heal_* rows.
+    obs_ledger.log_event(event, src="shardstore",
+                         job=os.environ.get("OBS_PHASE", ""), **fields)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# --- the layout facts the manifest records -----------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _Spec:
+    """A param leaf as shape+dtype — what ``plan_buckets`` and the
+    regroup need, with no array attached."""
+    shape: tuple
+    dtype: np.dtype
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+
+class ShardLayout:
+    """Plain-data description of a run's row layout: everything the
+    store needs to slice rows at save time and regroup them at restore
+    time, recorded verbatim in the manifest so restoring onto a
+    DIFFERENT mesh width recomputes nothing it can't verify."""
+
+    def __init__(self, update_layout: str, bucket_bytes: int,
+                 param_specs: list[_Spec], num_ranks: int,
+                 plan: list[list[int]] | None = None):
+        if update_layout not in ("zero3_rows", "bucket_rows"):
+            raise ValueError(
+                f"unknown row layout {update_layout!r} — the shard store "
+                f"is the zero1/zero3 snapshot format (tree-layout runs "
+                f"use resilience/snapshot.py)")
+        if num_ranks < 2:
+            raise ValueError(f"row layouts shard over >= 2 ranks, "
+                             f"got {num_ranks}")
+        self.update_layout = update_layout
+        self.bucket_bytes = int(bucket_bytes)
+        self.param_specs = list(param_specs)
+        self.num_ranks = int(num_ranks)
+        # The plan is a pure function of (leaf specs, byte cap) — NOT
+        # of D — which is the whole reason a shard set can regroup onto
+        # another width.  Recomputing here (instead of trusting a
+        # caller) keeps the manifest honest.
+        self.plan = plan if plan is not None else plan_buckets(
+            self.param_specs, self.bucket_bytes)
+
+    @classmethod
+    def for_params(cls, update_layout: str, bucket_bytes: int, params,
+                   num_ranks: int) -> "ShardLayout":
+        """From the TREE-form params (before the row re-layout)."""
+        specs = [_Spec(tuple(int(d) for d in l.shape), np.dtype(l.dtype))
+                 for l in jax.tree.leaves(params)]
+        return cls(update_layout, bucket_bytes, specs, num_ranks)
+
+    def bucket_width(self, b: int, num_ranks: int) -> int:
+        """Columns of bucket ``b``'s ``[D, W]`` layout at width
+        ``num_ranks`` — per-leaf zero padding to ``ceil(n/D)``, summed
+        (the one D-dependent part of the layout)."""
+        return sum(-(-self.param_specs[i].size // num_ranks)
+                   for i in self.plan[b])
+
+    def to_manifest(self) -> dict:
+        return {"update_layout": self.update_layout,
+                "bucket_bytes": self.bucket_bytes,
+                "param_specs": [[list(s.shape), s.dtype.name]
+                                for s in self.param_specs],
+                "plan": [list(b) for b in self.plan]}
+
+    @classmethod
+    def from_manifest(cls, m: dict) -> "ShardLayout":
+        specs = [_Spec(tuple(shape), np.dtype(dt))
+                 for shape, dt in m["param_specs"]]
+        return cls(m["update_layout"], m["bucket_bytes"], specs,
+                   m["num_ranks"], plan=[list(b) for b in m["plan"]])
+
+
+# --- pure-numpy regroup (byte-movement twins of parallel/bucketing) ----
+
+def _unbucket(flat: np.ndarray, specs: list[_Spec],
+              num_ranks: int) -> list[np.ndarray]:
+    """Inverse of the bucket row layout at width ``num_ranks``: slice
+    the ``[D*W]`` flat back into exact leaf values, padding dropped —
+    ``parallel/bucketing._unbucket_rows`` in numpy (bitwise: both only
+    move bytes)."""
+    rows = np.asarray(flat).reshape(num_ranks, -1)
+    out, off = [], 0
+    for spec in specs:
+        w = -(-spec.size // num_ranks)
+        out.append(rows[:, off:off + w].ravel()[:spec.size]
+                   .reshape(spec.shape))
+        off += w
+    if off != rows.shape[1]:
+        raise ValueError(
+            f"bucket flat has {rows.shape[1]} columns; its leaf specs "
+            f"account for {off} — the saved plan does not describe this "
+            f"shard set")
+    return out
+
+
+def _rebucket(values: list[np.ndarray], num_ranks: int) -> np.ndarray:
+    """The bucket flat at width ``num_ranks``: per-leaf zero-pad to a
+    multiple of D, ``[D, ceil(n/D)]`` blocks concatenated column-wise,
+    raveled — ``parallel/bucketing._bucket_flat2d(...).ravel()`` in
+    numpy."""
+    cols = []
+    for v in values:
+        flat = np.asarray(v).ravel()
+        pad = (-flat.size) % num_ranks
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        cols.append(flat.reshape(num_ranks, -1))
+    return np.concatenate(cols, axis=1).ravel()
+
+
+def _is_row(leaf, num_ranks: int) -> bool:
+    """A saveable leaf is a 1/D row iff it is a flat vector whose length
+    the mesh divides AND it is actually sharded (the RNG key is a flat
+    replicated vector — replication is the discriminator, not shape)."""
+    return (isinstance(leaf, jax.Array) and leaf.ndim == 1
+            and leaf.size > 0 and leaf.size % num_ranks == 0
+            and not leaf.sharding.is_fully_replicated)
+
+
+def _classify(saveable: dict, num_ranks: int):
+    """Split each field's flatten-order leaves into (row, replicated)
+    position lists — THE one classification save and restore share, so
+    the positional correspondence between a shard set and a live state
+    cannot drift."""
+    out = {}
+    for fname, sub in saveable.items():
+        rows, repl = [], []
+        for j, leaf in enumerate(jax.tree.leaves(sub)):
+            (rows if _is_row(leaf, num_ranks) else repl).append(j)
+        out[fname] = (rows, repl)
+    return out
+
+
+# --- the store ---------------------------------------------------------
+
+class ShardStore:
+    """Per-rank shard files + ring mirrors + quorum manifest under
+    ``directory`` (one ``shards_<step>/`` dir per step; coexists with
+    SnapshotStore's monolithic files in the same directory — the fleet's
+    ``valid_steps`` unions both formats)."""
+
+    def __init__(self, directory: str, layout: ShardLayout | None = None,
+                 keep: int = 3, redundancy: int | None = None):
+        self._dir = directory
+        self._layout = layout
+        self._keep = keep
+        r = (redundancy if redundancy is not None
+             else _env_int("SNAPSHOT_REDUNDANCY", 2))
+        self._redundancy = max(1, r)
+
+    # -- paths ----------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, f"shards_{step:08d}")
+
+    def _rank_dir(self, step: int, rank: int) -> str:
+        return os.path.join(self._step_dir(step), f"rank_{rank:05d}")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), "manifest.json")
+
+    def steps(self) -> list[int]:
+        try:
+            names = os.listdir(self._dir)
+        except FileNotFoundError:
+            return []
+        return sorted(int(m.group(1)) for n in names
+                      if (m := _STEP_DIR_RE.match(n)))
+
+    def manifest(self, step: int) -> dict | None:
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- write path -----------------------------------------------------
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        """Monkeypatch seam (tests inject ENOSPC/EIO here), delegating
+        to THE atomic-write implementation (obs/recorder.py)."""
+        obs_recorder.atomic_write(path, data)
+
+    def _write_retrying(self, path: str, data: bytes) -> None:
+        """Bounded retry/backoff around one atomic payload write: a
+        flaky disk costs ``SNAPSHOT_IO_RETRIES`` extra attempts with
+        ``SNAPSHOT_IO_BACKOFF_S``-doubling sleeps; a dead one re-raises
+        to the save's OSError contract (logged + counted, never fatal)."""
+        retries = max(0, _env_int("SNAPSHOT_IO_RETRIES", 2))
+        backoff = max(0.0, _env_float("SNAPSHOT_IO_BACKOFF_S", 0.05))
+        for attempt in range(retries + 1):
+            try:
+                self._atomic_write(path, data)
+                return
+            except OSError as e:
+                if attempt == retries:
+                    raise
+                _IO_RETRIES.inc()
+                _log(f"write {os.path.basename(path)} failed ({e}) — "
+                     f"retry {attempt + 1}/{retries} in "
+                     f"{backoff * (2 ** attempt):.3f}s")
+                time.sleep(backoff * (2 ** attempt))
+
+    def _serialize(self, state: TrainState):
+        """(per-rank own bytes, repl bytes, per-field row/repl census).
+        Refuses a state whose row leaves don't match the layout's
+        bucket plan — a manifest must describe what is actually on
+        disk, or quorum means nothing."""
+        lay = self._layout
+        if lay is None:
+            raise ValueError("ShardStore.save needs the run's "
+                             "ShardLayout (see ShardLayout.for_params)")
+        D = lay.num_ranks
+        saveable = saveable_state_dict(state)
+        rank_payload: dict[int, dict[str, np.ndarray]] = {
+            r: {} for r in range(D)}
+        repl_payload: dict[str, np.ndarray] = {}
+        fields: dict[str, dict] = {}
+        n_buckets = len(lay.plan)
+        for fname, sub in saveable.items():
+            leaves = jax.tree.leaves(sub)
+            rows_meta, n_repl, ri = [], 0, 0
+            for leaf in leaves:
+                if _is_row(leaf, D):
+                    arr = np.asarray(leaf)
+                    mat = arr.reshape(D, -1)
+                    key = f"{fname}__{ri:05d}"
+                    for r in range(D):
+                        rank_payload[r][key] = mat[r]
+                    rows_meta.append({"size": int(arr.size)})
+                    ri += 1
+                else:
+                    repl_payload[f"{fname}__{n_repl:05d}"] = np.asarray(leaf)
+                    n_repl += 1
+            if rows_meta:
+                # Bucket correspondence: a field's row leaves come
+                # bucket-major with a uniform per-bucket count M (1 for
+                # zero3 params; the optimizer's moment count for opt
+                # state), sized D*W_b.  Anything else means the state
+                # is not the layout this store was built for.
+                if len(rows_meta) % n_buckets:
+                    raise ValueError(
+                        f"field {fname!r} holds {len(rows_meta)} row "
+                        f"leaves over {n_buckets} buckets — not a whole "
+                        f"number per bucket; this state does not match "
+                        f"the store's bucket plan")
+                m_per = len(rows_meta) // n_buckets
+                for j, rm in enumerate(rows_meta):
+                    want = D * lay.bucket_width(j // m_per, D)
+                    if rm["size"] != want:
+                        raise ValueError(
+                            f"field {fname!r} row leaf {j} has "
+                            f"{rm['size']} elements; bucket "
+                            f"{j // m_per} at D={D} lays out {want} — "
+                            f"this state does not match the store's "
+                            f"bucket plan")
+            fields[fname] = {"rows": rows_meta, "repl": n_repl}
+        if not any(f["rows"] for f in fields.values()):
+            raise ValueError(
+                "state holds no 1/D row leaves — the shard store is the "
+                "row-layout snapshot format; tree-layout runs use "
+                "resilience/snapshot.py SnapshotStore")
+
+        def _npz(payload: dict) -> bytes:
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            return buf.getvalue()
+
+        # One serialization per logical payload: mirrors are the SAME
+        # bytes, so copy digests are comparable by construction.
+        own = {r: _npz(rank_payload[r]) for r in range(D)}
+        return own, _npz(repl_payload), fields
+
+    def save(self, state: TrainState, cursor: dict | None = None,
+             meta: dict | None = None) -> int:
+        """Write one quorum-committed shard set for ``state``'s step:
+        every rank's ``own.npz``, its ring mirrors, the replicated
+        payload on ranks ``0..R-1`` — all atomic, all fsynced — and the
+        manifest LAST.  Returns the step.  Raises OSError only after
+        the bounded retries are exhausted (hook callers log + count)."""
+        lay = self._layout
+        step = int(state.step)
+        own, repl_bytes, fields = self._serialize(state)
+        D = lay.num_ranks
+        R = min(self._redundancy, D)
+        sdir = self._step_dir(step)
+        with span("shard_snapshot", step=step):
+            os.makedirs(sdir, exist_ok=True)
+            digests = {f"own_{s:05d}": hashlib.sha256(own[s]).hexdigest()
+                       for s in range(D)}
+            digests["repl"] = hashlib.sha256(repl_bytes).hexdigest()
+            for r in range(D):
+                rdir = self._rank_dir(step, r)
+                os.makedirs(rdir, exist_ok=True)
+                self._write_retrying(os.path.join(rdir, "own.npz"), own[r])
+                # Ring mirrors: rank r holds a byte-identical copy of
+                # the R-1 shards BEHIND it on the ring, so any R-1
+                # contiguous (or scattered) rank-dir losses leave every
+                # shard one intact copy.
+                for m in range(1, R):
+                    s = (r - m) % D
+                    self._write_retrying(
+                        os.path.join(rdir, f"mirror_{s:05d}.npz"), own[s])
+                if r < R:
+                    self._write_retrying(
+                        os.path.join(rdir, "repl.npz"), repl_bytes)
+            manifest = {"version": MANIFEST_VERSION, "step": step,
+                        "num_ranks": D, "redundancy": R,
+                        "fields": fields, "digests": digests,
+                        "cursor": dict(cursor or {}),
+                        "meta": dict(meta or {}),
+                        **lay.to_manifest()}
+            self._write_retrying(
+                self._manifest_path(step),
+                json.dumps(manifest, sort_keys=True).encode())
+            # Durability of the renames themselves: fsync the step dir
+            # (atomic_write fsyncs file CONTENTS; the directory entry
+            # needs its own).
+            try:
+                fd = os.open(sdir, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+        self._trim()
+        _SAVES.inc()
+        _event("ckpt_save", step=step, ranks=D, redundancy=R,
+               nbytes=sum(len(b) for b in own.values()))
+        return step
+
+    def _trim(self) -> None:
+        if self._keep <= 0:
+            return
+        for s in self.steps()[:-self._keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def discard_newer(self, step: int) -> list[int]:
+        """Delete every shard set newer than ``step`` — the fleet
+        agreement's divergence discard, same contract as
+        ``SnapshotStore.discard_newer``."""
+        dropped = []
+        for s in self.steps():
+            if s > step:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+                if not os.path.isdir(self._step_dir(s)):
+                    dropped.append(s)
+        return dropped
+
+    # -- validation / quorum --------------------------------------------
+
+    def _copies(self, step: int, shard: int, manifest: dict):
+        """Every on-disk location shard ``shard`` may live at, own
+        first, ring mirrors after — ``(path, holder_rank)`` pairs."""
+        D = manifest["num_ranks"]
+        out = [(os.path.join(self._rank_dir(step, shard), "own.npz"),
+                shard)]
+        for m in range(1, manifest["redundancy"]):
+            h = (shard + m) % D
+            out.append((os.path.join(self._rank_dir(step, h),
+                                     f"mirror_{shard:05d}.npz"), h))
+        return out
+
+    def _good_bytes(self, path: str, want_digest: str):
+        """(bytes, why_bad): read one copy and check its sha256 — a
+        mismatch is COUNTED (that is the bit-rot detection the digests
+        exist for) and the copy refused."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            return None, f"unreadable ({e.__class__.__name__})"
+        if hashlib.sha256(data).hexdigest() != want_digest:
+            _DIGEST_MISMATCHES.inc()
+            return None, "digest mismatch"
+        return data, None
+
+    def shard_census(self, step: int, manifest: dict | None = None):
+        """Per-shard intact-copy count + repl count — the quorum facts
+        (validate's detail and the refusal message's evidence)."""
+        manifest = manifest or self.manifest(step)
+        if manifest is None:
+            return None
+        D = manifest["num_ranks"]
+        census = {}
+        for s in range(D):
+            ok = 0
+            for path, _holder in self._copies(step, s, manifest):
+                data, _why = self._good_bytes(
+                    path, manifest["digests"][f"own_{s:05d}"])
+                if data is not None:
+                    ok += 1
+            census[s] = ok
+        repl_ok = 0
+        for r in range(manifest["redundancy"]):
+            data, _why = self._good_bytes(
+                os.path.join(self._rank_dir(step, r), "repl.npz"),
+                manifest["digests"]["repl"])
+            if data is not None:
+                repl_ok += 1
+        return {"shards": census, "repl": repl_ok}
+
+    def validate(self, step: int):
+        """(ok, why): quorum-valid iff the manifest parses AND every
+        shard has >= 1 digest-intact copy AND the replicated payload
+        does too."""
+        manifest = self.manifest(step)
+        if manifest is None:
+            return False, "missing or unparseable manifest"
+        census = self.shard_census(step, manifest)
+        bad = [s for s, n in census["shards"].items() if n == 0]
+        if bad:
+            return False, (f"shards {bad} have no intact copy "
+                           f"(R={manifest['redundancy']})")
+        if census["repl"] == 0:
+            return False, "replicated payload has no intact copy"
+        return True, "ok"
+
+    def quorum_steps(self) -> list[int]:
+        return [s for s in self.steps() if self.validate(s)[0]]
+
+    def latest_valid(self) -> int | None:
+        steps = self.quorum_steps()
+        return steps[-1] if steps else None
+
+    # -- read path ------------------------------------------------------
+
+    def _load(self, step: int):
+        """(manifest, {field: [row flats at D_saved]}, {field: [repl
+        arrays]}, reconstructed shard list).  Refuses BY NAME when any
+        shard's loss exceeds redundancy — a half-reconstructed state
+        must never train."""
+        manifest = self.manifest(step)
+        if manifest is None:
+            raise ValueError(f"shard set {step} has no readable "
+                             f"manifest — the write never committed")
+        D = manifest["num_ranks"]
+        shard_rows: list[dict] = []
+        reconstructed: list[int] = []
+        for s in range(D):
+            data = None
+            for path, holder in self._copies(step, s, manifest):
+                data, why = self._good_bytes(
+                    path, manifest["digests"][f"own_{s:05d}"])
+                if data is not None:
+                    if holder != s:
+                        reconstructed.append(s)
+                        _RECONSTRUCTIONS.inc()
+                        _event("ckpt_reconstruct", step=step, shard=s,
+                               source_rank=holder)
+                        _log(f"step {step}: shard {s} rebuilt from rank "
+                             f"{holder}'s ring mirror")
+                    break
+                _event("ckpt_digest_mismatch" if why == "digest mismatch"
+                       else "ckpt_copy_unreadable", step=step, shard=s,
+                       file=os.path.relpath(path, self._dir))
+            if data is None:
+                census = self.shard_census(step, manifest)
+                _REFUSALS.inc()
+                _event("ckpt_refused", step=step, shard=s,
+                       census=census["shards"],
+                       redundancy=manifest["redundancy"])
+                raise ModeRefusal(
+                    f"shard {s} of step {step} has NO intact copy (own "
+                    f"and every ring mirror missing or digest-refused; "
+                    f"census {census['shards']}) — loss exceeds "
+                    f"redundancy R={manifest['redundancy']}. Refusing "
+                    f"to restore a partial state; resume from an older "
+                    f"quorum-valid step, or raise SNAPSHOT_REDUNDANCY "
+                    f"at save time to survive more")
+            with np.load(io.BytesIO(data)) as z:
+                shard_rows.append({k: z[k] for k in z.files})
+        repl_data = None
+        for r in range(manifest["redundancy"]):
+            repl_data, _why = self._good_bytes(
+                os.path.join(self._rank_dir(step, r), "repl.npz"),
+                manifest["digests"]["repl"])
+            if repl_data is not None:
+                break
+        if repl_data is None:
+            _REFUSALS.inc()
+            raise ModeRefusal(
+                f"step {step}: the replicated payload has no intact "
+                f"copy on ranks 0..{manifest['redundancy'] - 1} — loss "
+                f"exceeds redundancy R={manifest['redundancy']}")
+        field_rows: dict[str, list[np.ndarray]] = {}
+        for fname, fmeta in manifest["fields"].items():
+            flats = []
+            for j in range(len(fmeta["rows"])):
+                key = f"{fname}__{j:05d}"
+                flats.append(np.concatenate(
+                    [shard_rows[s][key] for s in range(D)]))
+            field_rows[fname] = flats
+        field_repl: dict[str, list[np.ndarray]] = {}
+        with np.load(io.BytesIO(repl_data)) as z:
+            for fname, fmeta in manifest["fields"].items():
+                field_repl[fname] = [z[f"{fname}__{j:05d}"]
+                                     for j in range(fmeta["repl"])]
+        return manifest, field_rows, field_repl, reconstructed
+
+    def _install(self, state: TrainState, manifest, field_rows,
+                 field_repl, num_ranks: int) -> TrainState:
+        """Positional install into ``state``'s structure+shardings —
+        row leaves from the reassembled flats, replicated leaves from
+        the repl payload, each put back with its template's sharding."""
+        template = saveable_state_dict(state)
+        restored = {}
+        for fname, sub in template.items():
+            leaves, treedef = jax.tree.flatten(sub)
+            fmeta = manifest["fields"].get(fname)
+            if fmeta is None:
+                raise ValueError(
+                    f"shard set {manifest['step']} has no field "
+                    f"{fname!r} — the state structure changed since it "
+                    f"was written")
+            rows = list(field_rows[fname])
+            repl = list(field_repl[fname])
+            new_leaves = []
+            for leaf in leaves:
+                src = (rows if _is_row(leaf, num_ranks) else repl)
+                if not src:
+                    raise ValueError(
+                        f"shard set {manifest['step']} field {fname!r} "
+                        f"ran out of saved leaves — the model/optimizer "
+                        f"changed since it was written")
+                val = src.pop(0)
+                new_leaves.append(
+                    jax.device_put(val, leaf.sharding)
+                    if isinstance(leaf, jax.Array) else val)
+            if rows or repl:
+                raise ValueError(
+                    f"shard set {manifest['step']} field {fname!r} holds "
+                    f"{len(rows)} row + {len(repl)} replicated leaves "
+                    f"this run's state has no position for — the "
+                    f"model/optimizer changed since it was written")
+            restored[fname] = jax.tree.unflatten(treedef, new_leaves)
+        return state.replace(**restored)
+
+    def restore(self, state: TrainState, mesh,
+                step: int | None = None) -> TrainState:
+        """Same-width restore into an already-laid-out ROW state.
+        Refuses a width mismatch by name: the 1/D row layout is
+        structural, and the sanctioned cross-width path is
+        :meth:`restore_elastic` (the engine re-layout pass)."""
+        step = self.latest_valid() if step is None else step
+        if step is None:
+            return state
+        manifest = self.manifest(step)
+        if manifest is None:
+            raise ValueError(f"shard set {step} has no readable manifest")
+        if manifest["num_ranks"] != mesh.size:
+            _REFUSALS.inc()
+            raise ModeRefusal(
+                f"shard set at step {step} was written by "
+                f"{manifest['num_ranks']} ranks; this mesh has "
+                f"{mesh.size} — the 1/D row layout is structural, so a "
+                f"positional restore would interleave rows from the "
+                f"wrong width. Use ShardStore.restore_elastic (the "
+                f"engine layout regroup) to restore across widths")
+        manifest, field_rows, field_repl, recon = self._load(step)
+        out = self._install(state, manifest, field_rows, field_repl,
+                            mesh.size)
+        _RESTORES.inc()
+        _event("ckpt_restore", step=step,
+               from_ranks=manifest["num_ranks"], to_ranks=mesh.size,
+               elastic=False, reconstructed=recon)
+        return out
+
+    def restore_elastic(self, state: TrainState, tx, *, mesh,
+                        step: int | None = None):
+        """Restore a shard set of ANY width onto ``mesh``: reassemble
+        exact param values from the saved rows, run them through the
+        engine's ONE re-layout pass (``apply_update_layout``) at the
+        new width, and regroup the optimizer-moment rows with the same
+        byte movement.  ``state`` must be the fresh TREE-layout state
+        on the new mesh (params as the param tree — what
+        ``TrainState.create`` builds, BEFORE any row re-layout).
+
+        Returns ``(row_state, aux)`` with ``aux`` carrying the layout
+        object the engine pass built (``zero3_layout``, None for
+        zero1), the restored ``step``, the saved dataset ``cursor``,
+        and ``from_ranks``.  Bitwise: every move here and in the
+        engine pass is byte movement around zero padding — a D=4 set
+        restored at D=2 materializes exactly the saver's state
+        (tests/test_checkpoint.py pins it)."""
+        step = self.latest_valid() if step is None else step
+        if step is None:
+            raise ValueError(
+                f"no quorum-valid shard step in {self._dir} — nothing "
+                f"to restore")
+        manifest, field_rows, field_repl, recon = self._load(step)
+        lay = ShardLayout.from_manifest(manifest)
+        d_old, d_new = lay.num_ranks, mesh.size
+        n_buckets = len(lay.plan)
+        # The NEW mesh is the placement authority for everything the
+        # engine pass consumes: a template built off-mesh (plain
+        # TrainState.create) must not leak single-device placement into
+        # the re-layout.
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl_sharding = NamedSharding(mesh, PartitionSpec())
+
+        # (1) Exact param values back from the saved width's rows.
+        if lay.update_layout == "zero3_rows":
+            if len(field_rows["params"]) != n_buckets:
+                raise ValueError(
+                    f"shard set {step} holds "
+                    f"{len(field_rows['params'])} param buckets; its "
+                    f"plan names {n_buckets} — manifest is inconsistent")
+            values = []
+            for b, flat in enumerate(field_rows["params"]):
+                values.extend(_unbucket(
+                    flat, [lay.param_specs[i] for i in lay.plan[b]],
+                    d_old))
+            # _unbucket emits bucket-member order == plan order ==
+            # canonical flatten order (plan_buckets is order-preserving).
+            param_values = values
+        else:                                  # bucket_rows: params repl
+            param_values = list(field_repl["params"])
+        t_leaves, treedef = jax.tree.flatten(state.params)
+        if len(param_values) != len(t_leaves):
+            raise ValueError(
+                f"shard set {step} restores {len(param_values)} param "
+                f"leaves; this run's model has {len(t_leaves)} — the "
+                f"model changed since it was written")
+        for v, t in zip(param_values, t_leaves):
+            if tuple(v.shape) != tuple(t.shape):
+                raise ValueError(
+                    f"shard set {step} param leaf shape {tuple(v.shape)} "
+                    f"does not match the model's {tuple(t.shape)} — the "
+                    f"model changed since it was written")
+        params = jax.tree.unflatten(
+            treedef, [jax.device_put(v, repl_sharding)
+                      for v in param_values])
+
+        # (2) Replicated fields (step, rng, BN stats) install as-is.
+        state = state.replace(params=params)
+        for fname in ("step", "rng", "batch_stats"):
+            vals = list(field_repl.get(fname, []))
+            leaves, fdef = jax.tree.flatten(
+                saveable_state_dict(state)[fname])
+            if len(vals) != len(leaves):
+                raise ValueError(
+                    f"shard set {step} field {fname!r} holds "
+                    f"{len(vals)} leaves; this run's state has "
+                    f"{len(leaves)} — the state structure changed")
+            if leaves:
+                state = state.replace(**{fname: jax.tree.unflatten(
+                    fdef, [jax.device_put(v, repl_sharding)
+                           if isinstance(t, jax.Array) else v
+                           for v, t in zip(vals, leaves)])})
+
+        # (3) The engine's one re-layout pass, at the NEW width.
+        # Lazy import: the engine owns layout wiring; this module only
+        # feeds it (and nothing above engine imports shardstore at
+        # module scope, so no cycle).
+        from distributedtensorflowexample_tpu.engine.engine import (
+            apply_update_layout)
+        state, zero3_layout = apply_update_layout(
+            state, tx, update_layout=lay.update_layout,
+            bucket_bytes=lay.bucket_bytes, mesh=mesh)
+
+        # (4) Graft the optimizer-moment rows: unbucket at the saved
+        # width, rebucket at the new one (same plan — it is
+        # D-independent), and put each flat back with its target row
+        # sharding.  Scalars (schedule counts) come from repl.
+        saved_rows = list(field_rows.get("opt_state", []))
+        saved_repl = list(field_repl.get("opt_state", []))
+        leaves, odef = jax.tree.flatten(state.opt_state)
+        row_pos = [j for j, l in enumerate(leaves) if _is_row(l, d_new)]
+        repl_pos = [j for j in range(len(leaves)) if j not in row_pos]
+        if len(saved_rows) != len(row_pos) \
+                or len(saved_repl) != len(repl_pos):
+            raise ValueError(
+                f"shard set {step} optimizer state holds "
+                f"{len(saved_rows)} row + {len(saved_repl)} replicated "
+                f"leaves; this run's has {len(row_pos)} + "
+                f"{len(repl_pos)} — the optimizer changed since it was "
+                f"written")
+        if saved_rows:
+            m_per = len(saved_rows) // n_buckets
+            for k, (j, flat_old) in enumerate(zip(row_pos, saved_rows)):
+                specs = [lay.param_specs[i] for i in lay.plan[k // m_per]]
+                flat_new = _rebucket(_unbucket(flat_old, specs, d_old),
+                                     d_new)
+                if flat_new.size != leaves[j].size:
+                    raise ValueError(
+                        f"regrouped opt row {k} has {flat_new.size} "
+                        f"elements; the new layout expects "
+                        f"{leaves[j].size} — bucket plans diverged")
+                leaves[j] = jax.device_put(flat_new, leaves[j].sharding)
+        for j, v in zip(repl_pos, saved_repl):
+            leaves[j] = (jax.device_put(v, leaves[j].sharding)
+                         if isinstance(leaves[j], jax.Array) else v)
+        state = state.replace(opt_state=jax.tree.unflatten(odef, leaves))
+
+        _RESTORES.inc()
+        _event("ckpt_restore", step=step, from_ranks=d_old,
+               to_ranks=d_new, elastic=d_old != d_new,
+               reconstructed=recon)
+        if d_old != d_new:
+            _log(f"elastic restore: step {step} regrouped "
+                 f"D={d_old} -> D={d_new} through the engine layout "
+                 f"pass")
+        return state, {"zero3_layout": zero3_layout, "step": step,
+                       "cursor": manifest.get("cursor", {}),
+                       "from_ranks": d_old,
+                       "reconstructed": recon}
+
+    # -- fault seams (tools/faultline.py's shard_loss / bitflip) --------
+
+    def drop_rank_dir(self, rank: int, step: int | None = None):
+        """Delete one rank's whole directory in the newest shard set —
+        the ``shard_loss`` fault (a lost host's local disk)."""
+        step = self.steps()[-1] if step is None and self.steps() else step
+        if step is None:
+            return None
+        shutil.rmtree(self._rank_dir(step, rank), ignore_errors=True)
+        return step
+
+    def flip_payload_byte(self, rank: int, step: int | None = None):
+        """Flip one byte in the middle of one rank's ``own.npz``,
+        in place and deliberately NOT atomically — silent bit rot the
+        manifest digest must catch (the ``bitflip`` fault)."""
+        step = self.steps()[-1] if step is None and self.steps() else step
+        if step is None:
+            return None
+        path = os.path.join(self._rank_dir(step, rank), "own.npz")
+        try:
+            with open(path, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                off = f.tell() // 2
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+            return step, off
+        except OSError:
+            return None
+
+
+# --- module helpers (the fleet/remediator quorum seam) -----------------
+
+def shard_steps(directory: str) -> list[int]:
+    return ShardStore(directory).steps()
+
+
+def quorum_valid_steps(directory: str) -> list[int]:
+    """Steps whose shard set reaches quorum (every shard + repl has an
+    intact copy) — unioned into ``snapshot.valid_steps``, which is what
+    the fleet resume agreement and the Remediator's rollback actuator
+    rank steps by."""
+    return ShardStore(directory).quorum_steps()
+
+
+def discard_newer(directory: str, step: int) -> list[int]:
+    return ShardStore(directory).discard_newer(step)
+
+
+# --- the hook ----------------------------------------------------------
+
+class ShardSnapshotHook(Hook):
+    """Periodic + final shard-set save (SnapshotHook's shape, the shard
+    store's format).  An OSError that survives the bounded retries is
+    logged + counted, never raised — losing one snapshot interval is
+    recoverable by design; killing the run here is not."""
+
+    def __init__(self, store: ShardStore, every: int = 1,
+                 cursor: dict | None = None):
+        self._store = store
+        self._due = _EveryN(every)
+        self._cursor = dict(cursor or {})
+        self._last_saved: int | None = None
+
+    def begin(self, loop) -> None:
+        self._due = _EveryN(self._due._every, int(loop.start_step))
+        self._last_saved = None
+
+    def _save(self, state) -> bool:
+        step = int(state.step)
+        try:
+            self._store.save(state,
+                             cursor={**self._cursor, "step": step})
+            return True
+        except OSError as e:
+            _SAVE_FAILURES.inc()
+            _log(f"shard save at step {step} failed ({e}) — continuing; "
+                 f"the newest quorum-valid set on disk is unchanged and "
+                 f"the next interval retries")
+            return False
+
+    def after_step(self, step, state, metrics) -> bool:
+        if self._due(step) and self._save(state):
+            self._last_saved = int(state.step)
+        return False
+
+    def end(self, state) -> None:
+        if int(state.step) == self._last_saved:
+            return
+        self._save(state)

@@ -250,6 +250,14 @@ class SnapshotStore:
                      f"permissions before trusting a resume from here")
                 continue
             dropped.append(s)
+        # Shard sets past the agreed step are the SAME divergent
+        # timeline in the row-layout format — the agreement's discard
+        # must cover both or a later quorum-valid shard step would
+        # resurrect it (resilience/shardstore.py).
+        from distributedtensorflowexample_tpu.resilience import (
+            shardstore as _shardstore)
+        dropped = sorted(set(dropped)
+                         | set(_shardstore.discard_newer(self._dir, step)))
         if dropped:
             _log(f"discarded snapshot(s) {dropped} newer than agreed "
                  f"step {step} (divergent timeline)")
@@ -272,12 +280,21 @@ class SnapshotStore:
 
 
 def valid_steps(directory: str) -> list[int]:
-    """Steps in ``directory`` whose payload+manifest pass validation
-    (size + crc32), ascending — one rank's input to the fleet's
-    resume-step agreement.  Reads manifests and payload bytes only,
-    never deserializes state."""
+    """Steps in ``directory`` that pass validation, ascending — one
+    rank's input to the fleet's resume-step agreement and the
+    Remediator rollback actuator's notion of "good".  Both snapshot
+    formats count: monolithic payloads here (size + crc32) UNIONed
+    with the shard store's quorum-valid sets (every 1/D shard + the
+    replicated payload digest-intact, resilience/shardstore.py) — so
+    "the newest step the gang can provably agree on" already means
+    shard quorum for row-layout runs.  Reads manifests and payload
+    bytes only, never deserializes state."""
+    from distributedtensorflowexample_tpu.resilience import (
+        shardstore as _shardstore)
     store = SnapshotStore(directory)
-    return [s for s in store.steps() if store.validate(s)[0]]
+    steps = {s for s in store.steps() if store.validate(s)[0]}
+    steps.update(_shardstore.quorum_valid_steps(directory))
+    return sorted(steps)
 
 
 def newest_common_step(manifest_dirs: list[str]) -> int | None:

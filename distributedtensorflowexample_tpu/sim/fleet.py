@@ -150,6 +150,20 @@ class FleetHub:
         #: (job, steps credited at done) — the metrics pass proves
         #: credited == job.steps, i.e. zero steps lost to evictions.
         self.done_credits: dict[str, float] = {}
+        #: Snapshot world model (``snapshot_loss`` events): which ranks
+        #: have lost their shard since the last quorum-valid step, the
+        #: step the fleet agreement would fall back to if redundancy
+        #: runs out, and the tallies the harness surfaces when a
+        #: scenario scripts any loss.  Mirrors resilience/shardstore:
+        #: a loss WITHIN redundancy is a reconstruction (no progress
+        #: impact); losses at or past ``SNAPSHOT_REDUNDANCY`` roll the
+        #: job back to the quorum floor and re-run the gap — time is
+        #: lost, steps are re-earned, ``steps_lost()`` stays 0.
+        self.shard_losses: dict[str, set] = {}
+        self.quorum_floor: dict[str, int] = {}
+        self.snap_stats = {"losses": 0, "reconstructs": 0, "rollbacks": 0}
+        self.snapshot_redundancy = max(
+            1, int(os.environ.get("SNAPSHOT_REDUNDANCY", "") or 2))
 
     # --- work model ----------------------------------------------------
 
@@ -352,6 +366,33 @@ class FleetHub:
                 self.clock.now(),
                 lambda: self._complete(gang, gen, "wedged", result=res),
                 label=f"wedge:{ev.job}")
+        elif ev.kind == "snapshot_loss":
+            rank = ev.rank if ev.rank is not None else gang.ranks[0]
+            self._settle(gang)
+            lost = self.shard_losses.setdefault(ev.job, set())
+            if not lost:
+                # First loss since the last intact set: the newest
+                # quorum-valid step is frozen HERE — ring mirrors cover
+                # further losses until redundancy runs out.
+                self.quorum_floor[ev.job] = math.floor(
+                    self.steps_done[ev.job])
+            lost.add(rank)
+            self.snap_stats["losses"] += 1
+            if len(lost) >= self.snapshot_redundancy:
+                # Past redundancy: the newest shard set is
+                # unrecoverable.  Roll the job back to the quorum floor
+                # and relaunch through the scheduler — the gap re-runs,
+                # so the rollback costs TIME, never credited steps.
+                self.snap_stats["rollbacks"] += 1
+                self.steps_done[ev.job] = min(
+                    self.steps_done[ev.job],
+                    float(self.quorum_floor.pop(ev.job, 0)))
+                lost.clear()
+                gang.request_stop("snapshot_loss")
+            else:
+                # Within redundancy: the mirror rebuilds the shard
+                # out-of-band; training never notices.
+                self.snap_stats["reconstructs"] += 1
         else:
             raise ValueError(f"unhandled scenario event {ev.kind!r}")
 

@@ -190,6 +190,10 @@ class SimWorld:
             "steps_lost": self.hub.steps_lost(),
             "summary": summary,
         }
+        if self.hub.snap_stats["losses"]:
+            # Only when the scenario scripted snapshot_loss — scenarios
+            # without one keep their exact summary shape.
+            out["snapshots"] = dict(self.hub.snap_stats)
         if self.traffic is not None:
             out["serve"] = self.traffic.finalize()
             out["serve"]["actions_used"] = (

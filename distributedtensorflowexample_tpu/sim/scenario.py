@@ -51,7 +51,7 @@ import os
 from distributedtensorflowexample_tpu.resilience.scheduler import Job
 
 # What the simulated world can DO to the fleet, one line each.
-# KEEP-IN-SYNC(sim-scenario) digest=727dd16ed5a6
+# KEEP-IN-SYNC(sim-scenario) digest=caa363679294
 SCENARIO_EVENTS = (
     "host_loss",         # rank's host dies (elastic: shrink; else lost)
     "host_recover",      # lost host answers the recovery probe again
@@ -60,6 +60,7 @@ SCENARIO_EVENTS = (
     "gang_crash",        # whole gang crashes (rcs 1 → budgeted retry)
     "gang_wedge",        # gang reports backend wedged (rc 3 quarantine)
     "serve_load",        # offered serve traffic steps to a new level
+    "snapshot_loss",     # rank's snapshot shard lost (mirror or rollback)
 )
 # KEEP-IN-SYNC-END(sim-scenario)
 

@@ -344,3 +344,129 @@ def test_cross_mode_restore_is_refused(tmp_path, small_synthetic):
     with pytest.raises(ValueError, match="sync_mode='sync'"):
         run_training(RunConfig(train_steps=8, resume=True, sync_mode="async",
                                **common), "softmax", "mnist")
+
+
+# --- shard-redundant snapshots (resilience/shardstore.py) -------------------
+
+_BB = 1 << 20     # one bucket per dtype for the tiny softmax model
+
+
+def _trained_rows(tmp_path, D: int = 4, steps: int = 4, name: str = "store"):
+    """Train a D-wide ZeRO-3 softmax run a few steps, save one
+    shard-redundant snapshot set; return everything a restore needs."""
+    from distributedtensorflowexample_tpu.engine.engine import (
+        apply_update_layout)
+    from distributedtensorflowexample_tpu.resilience.shardstore import (
+        ShardLayout, ShardStore)
+
+    mesh = make_mesh(D)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = _fresh_state()
+    layout = ShardLayout.for_params("zero3_rows", _BB, state.params, D)
+    rows, z3 = apply_update_layout(state, tx, update_layout="zero3_rows",
+                                   bucket_bytes=_BB, mesh=mesh)
+    step_fn = make_train_step(mesh=mesh, zero3_layout=z3)
+    with mesh:
+        for b in _batches(steps):
+            rows, _ = step_fn(rows, b)
+    store_dir = str(tmp_path / name)
+    store = ShardStore(store_dir, layout=layout)
+    step = store.save(rows, cursor={"seed": 0})
+    return store_dir, rows, z3, mesh, tx, step
+
+
+def test_shard_restore_survives_any_single_rank_loss(tmp_path):
+    """R=2 ring mirroring: delete ANY one rank's whole shard directory —
+    every rank in turn — and restore still reconstructs that shard from
+    its neighbor's mirror, bitwise."""
+    import shutil
+
+    from distributedtensorflowexample_tpu.resilience.shardstore import (
+        ShardStore)
+
+    store_dir, rows, _z3, _mesh, tx, step = _trained_rows(tmp_path)
+    for rank in range(4):
+        wd = str(tmp_path / f"loss_{rank}")
+        shutil.copytree(store_dir, wd)
+        hurt = ShardStore(wd)
+        assert hurt.drop_rank_dir(rank) == step
+        ok, _why = hurt.validate(step)
+        assert ok                        # one loss is within R=2 quorum
+        mesh = make_mesh(4)
+        restored, aux = ShardStore(wd).restore_elastic(
+            _fresh_state(seed=9), tx, mesh=mesh)
+        assert aux["step"] == step and aux["reconstructed"] == [rank]
+        assert _trees_equal(restored, rows)
+
+
+def test_shard_bitflip_detected_and_reconstructed(tmp_path):
+    """Silent bit rot: one payload byte flipped in place.  The sha256
+    census refuses that copy, restores from the ring mirror instead, and
+    the result is still bitwise — the rot is never restored silently."""
+    from distributedtensorflowexample_tpu.resilience.shardstore import (
+        ShardStore)
+
+    store_dir, rows, _z3, _mesh, tx, step = _trained_rows(tmp_path)
+    hurt = ShardStore(store_dir)
+    flipped_step, _off = hurt.flip_payload_byte(1)
+    assert flipped_step == step
+    assert hurt.validate(step)[0]        # mirror intact → still quorum
+    mesh = make_mesh(4)
+    restored, aux = ShardStore(store_dir).restore_elastic(
+        _fresh_state(seed=9), tx, mesh=mesh)
+    assert aux["reconstructed"] == [1]
+    assert _trees_equal(restored, rows)
+
+
+def test_shard_loss_past_redundancy_refuses_by_name(tmp_path):
+    """Losing a shard's own copy AND its only ring mirror (R=2) must
+    refuse loudly, naming the shard, the census, and the remedy — never
+    restore a partial state."""
+    from distributedtensorflowexample_tpu.refusal import ModeRefusal
+    from distributedtensorflowexample_tpu.resilience.shardstore import (
+        ShardStore)
+
+    store_dir, _rows, _z3, _mesh, tx, step = _trained_rows(tmp_path)
+    hurt = ShardStore(store_dir)
+    hurt.drop_rank_dir(2)                # shard 2's own copy
+    hurt.drop_rank_dir(3)                # rank 3 held shard 2's mirror
+    ok, why = hurt.validate(step)
+    assert not ok and "no intact copy" in why
+    mesh = make_mesh(4)
+    # The step must be PINNED: unpinned restore sees no quorum-valid
+    # step at all (a different, also-loud error).
+    with pytest.raises(ModeRefusal, match="exceeds redundancy R=2"):
+        ShardStore(store_dir).restore_elastic(
+            _fresh_state(seed=9), tx, mesh=mesh, step=step)
+
+
+def test_elastic_restore_d4_d2_d4_roundtrip_bitwise(tmp_path):
+    """A D=4 shard set restored onto a D=2 mesh (and back) through the
+    engine layout pass: per-leaf row padding is the ONLY D-dependence,
+    so the materialized state — and the full round-tripped row state —
+    is bitwise the original."""
+    from distributedtensorflowexample_tpu.resilience.shardstore import (
+        ShardLayout, ShardStore)
+
+    store_dir, rows4, z3_4, _mesh, tx, step = _trained_rows(tmp_path)
+    mesh2 = make_mesh(2)
+    rows2, aux2 = ShardStore(store_dir).restore_elastic(
+        _fresh_state(seed=9), optax.sgd(0.1, momentum=0.9), mesh=mesh2)
+    assert aux2["step"] == step
+    assert aux2["from_ranks"] == 4 and mesh2.size == 2
+    z3_2 = aux2["zero3_layout"]
+    full4 = jax.tree.leaves(z3_4.materialize(rows4.params))
+    full2 = jax.tree.leaves(z3_2.materialize(rows2.params))
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(full4, full2, strict=True))
+    # ... and back up to D=4: the full row state (params AND bucketed
+    # optimizer moments) is bitwise what was first saved.
+    lay2 = ShardLayout.for_params("zero3_rows", _BB,
+                                  _fresh_state().params, 2)
+    d2_dir = str(tmp_path / "store_d2")
+    ShardStore(d2_dir, layout=lay2).save(rows2, cursor={"seed": 0})
+    mesh4 = make_mesh(4)
+    rows4b, aux4 = ShardStore(d2_dir).restore_elastic(
+        _fresh_state(seed=9), optax.sgd(0.1, momentum=0.9), mesh=mesh4)
+    assert aux4["from_ranks"] == 2
+    assert _trees_equal(rows4b, rows4)

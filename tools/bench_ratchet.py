@@ -146,9 +146,12 @@ def _lower_is_better(metric: str) -> bool:
 def check_zero_invariants(records: list[dict],
                           outages: set = frozenset()) -> list[dict]:
     """Must-be-zero metrics: the heal family's ``*_lost`` lines
-    (steps_lost, requests_lost) and the serving family's
+    (steps_lost, requests_lost), the serving family's
     ``*_mismatch`` lines (speculative-decode tokens diverging from
-    plain greedy).  A nonzero value is an UNEXPLAINED finding
+    plain greedy), and the checkpoint family's ``*_restore_failures`` /
+    ``*_unrecovered`` lines (a shard restore that failed, or rot the
+    digest caught but the mirror could not repair).  A nonzero value
+    is an UNEXPLAINED finding
     regardless of tolerance or noise — a remediation drill that lost a
     step is a broken resume protocol, and a spec-decode mismatch is a
     broken acceptance rule, not a slow one.  Gated on the NEWEST
@@ -159,7 +162,8 @@ def check_zero_invariants(records: list[dict],
     series: dict = {}
     for rec in records:
         metric = rec.get("metric", "")
-        if metric.endswith(("_lost", "_mismatch", "_violations")):
+        if metric.endswith(("_lost", "_mismatch", "_violations",
+                            "_restore_failures", "_unrecovered")):
             series.setdefault((metric, _platform(rec)), []).append(rec)
     findings = []
     for (metric, platform), recs in sorted(series.items()):
@@ -195,7 +199,8 @@ def compare_records(records: list[dict], tolerance: float,
     series: dict = {}
     for rec in records:
         if rec.get("metric", "").endswith(
-                ("_lost", "_mismatch", "_violations")):
+                ("_lost", "_mismatch", "_violations",
+                 "_restore_failures", "_unrecovered")):
             # check_zero_invariants owns the must-be-zero family: here
             # a fixed loss (1 -> 0) would read as a 100% "drop".
             continue
@@ -478,7 +483,8 @@ def main(argv: list[str] | None = None) -> int:
                         "record ratchet scans (the serving and heal "
                         "families regress like any bench family; heal "
                         "*_ms metrics gate lower-is-better and *_lost / "
-                        "*_mismatch / *_violations must stay zero)")
+                        "*_mismatch / *_violations / *_restore_failures "
+                        "/ *_unrecovered must stay zero)")
     p.add_argument("--baseline", default="",
                    help="BASELINE_SELF.json (default: in records_dir)")
     p.add_argument("--tolerance", type=float, default=0.10,

@@ -12,7 +12,7 @@ command (and one tier-1-safe smoke test):
 
 Plans (resilience/faults.py NAMED_PLANS): preempt, wedge, nan_loss,
 corrupt_batch, torn_snapshot, heartbeat_flap, journal_torn, slow_rank,
-none — or explicit specs like
+shard_loss, bitflip, none — or explicit specs like
 ``preemption@3`` / ``wedge@2:5.0`` / ``slow_rank@5:0.5%1`` (rank 1
 turns persistent straggler at step 5: every later boundary delayed
 0.5 s, heartbeats alive, survives resume), comma-separated.  The same
@@ -20,6 +20,19 @@ turns persistent straggler at step 5: every later boundary delayed
 anywhere.  Under the supervisor, faults are TRANSIENT by default: they
 fire on attempt 0 only (SUPERVISE_ATTEMPT), like the real corrupted
 batch or torn write they model.
+
+``--layout zero3`` runs the drill on a ``--mesh``-wide virtual CPU
+mesh with ZeRO-3 row state and the shard-redundant ShardStore
+(resilience/shardstore.py) in place of the monolithic SnapshotStore:
+snapshots are per-rank shard files + ring mirrors under a quorum
+manifest, resume goes through the engine's elastic regroup (so a
+``--mesh 2`` resume of a ``--mesh 4`` run is legal AND bitwise at the
+restore boundary), and the ``shard_loss``/``bitflip`` plans delete or
+rot exactly one shard after the final save.  ``%RANK`` on those plans
+names the MESH-SHARD index inside this process's store, not a fleet
+rank.  The emitted ``params_digest`` hashes the MATERIALIZED params —
+the width-independent parity handle (the row digest is 1/D-structured
+and only comparable at equal width).
 
 Fleet drills (tools/supervise_fleet.py) run one faultline per rank with
 the SAME plan text: a ``%rank`` suffix pins a spec to one rank
@@ -38,6 +51,7 @@ Everything else goes to stderr.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import hashlib
 import json
 import os
@@ -60,7 +74,21 @@ def _digest(state) -> str:
     return h.hexdigest()
 
 
+def _params_digest(state, zero3_layout) -> str:
+    """sha256 over the MATERIALIZED params: the width-independent half
+    of the parity handle (row leaves are 1/D-structured, so the full
+    state digest only compares at equal mesh width)."""
+    import jax
+    import numpy as np
+    h = hashlib.sha256()
+    params = zero3_layout.materialize(state.params)
+    for leaf in jax.tree.leaves(params):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
 _LM_DRILL_SEQ = 32      # short sequences keep LM drills tier-1-cheap
+_DRILL_BUCKET_BYTES = 1 << 20   # zero3 drills: one-ish bucket per dtype
 
 
 def _batch_stream(batch_size: int, seed: int, start_step: int,
@@ -127,6 +155,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--rank", type=int, default=None,
                    help="this process's rank for %%rank-targeted fault "
                         "specs (default: OBS_RANK, else 0)")
+    p.add_argument("--layout", default="tree",
+                   choices=["tree", "zero3"],
+                   help="zero3: ZeRO-3 row state on a --mesh-wide "
+                        "virtual CPU mesh with the shard-redundant "
+                        "ShardStore (shard_loss/bitflip plans live "
+                        "here; resume is elastic across widths)")
+    p.add_argument("--mesh", type=int, default=4,
+                   help="virtual CPU mesh width for --layout zero3")
     p.add_argument("--transient", default="true",
                    help="faults fire on SUPERVISE_ATTEMPT=0 only (a "
                         "retry models recovered hardware); false "
@@ -134,6 +170,13 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     truthy = lambda v: str(v).lower() in ("1", "true", "t", "yes", "y")
 
+    if args.layout == "zero3":
+        # Row layouts need a real multi-device mesh; give this process
+        # --mesh virtual CPU devices BEFORE the backend spins up.
+        from distributedtensorflowexample_tpu.compat import (
+            cpu_collective_flags, set_num_cpu_devices)
+        set_num_cpu_devices(args.mesh)
+        cpu_collective_flags()
     import jax
     # Standalone invocations must pin CPU in-process: this image's
     # sitecustomize force-registers the axon TPU platform and overrides
@@ -193,14 +236,30 @@ def main(argv: list[str] | None = None) -> int:
               f"fired (transient) — clean run", file=sys.stderr, flush=True)
         plan = FaultPlan([], seed=args.seed, name=f"{args.plan} (cleared)")
 
-    store = SnapshotStore(os.path.join(args.workdir, "snapshots"),
-                          keep=args.keep)
+    snap_dir = os.path.join(args.workdir, "snapshots")
+    store = SnapshotStore(snap_dir, keep=args.keep)
     model = build_model(args.model)
     sample = (jnp.zeros((args.batch, _LM_DRILL_SEQ), jnp.int32)
               if args.model.startswith("lm_") else
               jnp.zeros((args.batch, 28, 28, 1), jnp.float32))
-    state = TrainState.create(model, optax.sgd(0.1, momentum=0.9),
-                              sample, seed=args.seed)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = TrainState.create(model, tx, sample, seed=args.seed)
+    mesh = None
+    zero3_layout = None
+    shard_store = None
+    if args.layout == "zero3":
+        from distributedtensorflowexample_tpu.engine.engine import (
+            apply_update_layout)
+        from distributedtensorflowexample_tpu.parallel import make_mesh
+        from distributedtensorflowexample_tpu.resilience import (
+            ShardLayout, ShardSnapshotHook, ShardStore)
+        mesh = make_mesh(args.mesh)
+        shard_store = ShardStore(
+            snap_dir,
+            layout=ShardLayout.for_params("zero3_rows",
+                                          _DRILL_BUCKET_BYTES,
+                                          state.params, args.mesh),
+            keep=args.keep)
     agreed_txt = os.environ.get("FLEET_RESUME_STEP", "")
     if truthy(args.resume):
         if agreed_txt:
@@ -211,7 +270,8 @@ def main(argv: list[str] | None = None) -> int:
             # divergence the agreement exists to prevent).
             agreed = int(agreed_txt)
             if agreed > 0:
-                ok, why = store.validate(agreed)
+                active = shard_store if shard_store is not None else store
+                ok, why = active.validate(agreed)
                 if not ok:
                     print(f"faultline: fleet agreed resume step {agreed} "
                           f"is not valid in this rank's store ({why}) — "
@@ -221,10 +281,28 @@ def main(argv: list[str] | None = None) -> int:
                           flush=True)
                     obs_ledger.end_global(rc=1)
                     return 1
-                state = store.restore(state, step=agreed)
+                if shard_store is not None:
+                    state, shard_aux = shard_store.restore_elastic(
+                        state, tx, mesh=mesh, step=agreed)
+                    zero3_layout = shard_aux["zero3_layout"]
+                else:
+                    state = store.restore(state, step=agreed)
             # agreed == 0: no common step existed — start fresh.
+        elif shard_store is not None:
+            if shard_store.latest_valid() is not None:
+                # The elastic restore: ANY saved width regroups onto
+                # this mesh through the engine's one re-layout pass.
+                state, shard_aux = shard_store.restore_elastic(
+                    state, tx, mesh=mesh)
+                zero3_layout = shard_aux["zero3_layout"]
         else:
             state = store.restore(state)
+    if args.layout == "zero3" and zero3_layout is None:
+        # Fresh start (nothing restored): lay the tree state out as
+        # rows the same way the engine does.
+        state, zero3_layout = apply_update_layout(
+            state, tx, update_layout="zero3_rows",
+            bucket_bytes=_DRILL_BUCKET_BYTES, mesh=mesh)
     start_step = int(state.step)
     if start_step:
         print(f"faultline: resumed from snapshot at step {start_step}",
@@ -252,8 +330,11 @@ def main(argv: list[str] | None = None) -> int:
                          health_path=os.environ.get("OBS_HEALTH", ""),
                          health=RunHealth(rank=rank)),
              NaNGuardHook(), tape,
-             SnapshotHook(store, every=args.snapshot_every,
-                          cursor={"seed": args.seed}),
+             (ShardSnapshotHook(shard_store, every=args.snapshot_every,
+                                cursor={"seed": args.seed})
+              if shard_store is not None else
+              SnapshotHook(store, every=args.snapshot_every,
+                           cursor={"seed": args.seed})),
              FaultInjectionHook(plan)]
     hb = os.environ.get("SUPERVISE_HEARTBEAT", "")
     if hb:
@@ -267,13 +348,20 @@ def main(argv: list[str] | None = None) -> int:
         if digest_state is not None:
             rec["step"] = int(digest_state.step)
             rec["digest"] = _digest(digest_state)
+            if zero3_layout is not None:
+                rec["params_digest"] = _params_digest(digest_state,
+                                                      zero3_layout)
         print(json.dumps(rec, sort_keys=True), flush=True)
 
+    step_fn = (make_train_step(mesh=mesh, zero3_layout=zero3_layout)
+               if mesh is not None else make_train_step())
+    mesh_ctx = mesh if mesh is not None else contextlib.nullcontext()
     with sigterm_flag() as preempted:
-        loop = TrainLoop(make_train_step(), batches, args.steps,
+        loop = TrainLoop(step_fn, batches, args.steps,
                          hooks=hooks, should_stop=preempted)
         try:
-            state = loop.run(state)
+            with mesh_ctx:
+                state = loop.run(state)
         except FloatingPointError as e:
             # The guard fired before the poisoned state could be saved;
             # the newest snapshot on disk is the last healthy step.  No
@@ -296,6 +384,24 @@ def main(argv: list[str] | None = None) -> int:
                 torn = store.tear_latest()
                 print(f"faultline: tore snapshot {torn} mid-file",
                       file=sys.stderr, flush=True)
+            elif spec.kind in ("shard_loss", "bitflip"):
+                if shard_store is None:
+                    print(f"faultline: {spec.kind} needs the shard "
+                          f"store (--layout zero3) — no-op",
+                          file=sys.stderr, flush=True)
+                elif spec.kind == "shard_loss":
+                    hit = shard_store.drop_rank_dir(spec.rank or 0)
+                    print(f"faultline: dropped mesh-shard "
+                          f"{spec.rank or 0}'s whole directory from "
+                          f"shard set {hit}", file=sys.stderr,
+                          flush=True)
+                else:
+                    hit = shard_store.flip_payload_byte(spec.rank or 0)
+                    step_hit, off = hit if hit else (None, None)
+                    print(f"faultline: flipped payload byte {off} of "
+                          f"mesh-shard {spec.rank or 0} in shard set "
+                          f"{step_hit} (silent rot)", file=sys.stderr,
+                          flush=True)
             elif spec.kind == "journal_torn":
                 jp = os.environ.get("SUPERVISE_JOURNAL", "")
                 if jp and tear_journal(jp):

@@ -33,6 +33,15 @@ Drills (each a real end-to-end run, CPU-pinned, supervised):
   (serving/promote.Canary) with an injected latency regression; the
   window verdicts ROLLBACK, the canary arm drains to completion, and
   every request id lands exactly once.
+- **ckpt**: a D=4 ZeRO-3 run is preempted and its shard-redundant
+  snapshot set is damaged post-exit — one mesh-shard's whole directory
+  deleted, then separately one payload byte flipped (silent rot); the
+  fleet's resume agreement still votes for that step (R=2 quorum
+  holds), the relaunch RECONSTRUCTS the shard from its ring mirror —
+  the rot is caught by sha256, never restored silently — and the
+  finished run is bitwise the uninterrupted one.  Rides along:
+  ``ckpt_shard_restore_failures`` / ``ckpt_digest_mismatch_unrecovered``
+  must-be-zero rows.
 
 ``steps_lost`` is exact: the count of (step, loss) pairs from the
 uninterrupted reference run that no healed attempt reproduced bit-for-
@@ -169,7 +178,8 @@ def _fleet_drill(workdir: str, plan: str, steps: int, model: str, *,
                  ranks: int = 2, elastic: bool = False,
                  fleet_retries: int = 0, seed: int = 0,
                  poll_s: float = 0.2, max_heals: int = 2,
-                 anomaly_env: dict | None = None) -> dict:
+                 anomaly_env: dict | None = None,
+                 extra_argv: list | None = None) -> dict:
     """Run one faultline gang under full remediation; return the drill
     report (status, heals, ledger path, per-attempt tails)."""
     from distributedtensorflowexample_tpu.resilience import remediate
@@ -185,7 +195,7 @@ def _fleet_drill(workdir: str, plan: str, steps: int, model: str, *,
     argv = [sys.executable, FAULTLINE, "--plan", plan,
             "--steps", str(steps), "--model", model,
             "--workdir", os.path.join(workdir, "rank{rank}"),
-            "--keep", "50", "--seed", str(seed)]
+            "--keep", "50", "--seed", str(seed)] + list(extra_argv or [])
 
     def make_fleet() -> FleetSupervisor:
         return FleetSupervisor(
@@ -553,7 +563,120 @@ def drill_canary(base: str, size: str = "lm_tiny",
     ]
 
 
-DRILLS = ("slow_rank", "nan", "host_loss", "serve_slo", "canary")
+def _straight_zero3(workdir: str, model: str, steps: int, mesh: int,
+                    seed: int = 0) -> dict:
+    """The uninterrupted ZeRO-3 reference — a SUBPROCESS, not
+    in-process like :func:`_straight_run`: the row layout needs its own
+    --mesh virtual CPU devices, pinned before a backend spins up, and
+    this process's backend is already a 1-device CPU."""
+    import subprocess
+    _fresh(workdir)
+    out = subprocess.run(
+        [sys.executable, FAULTLINE, "--plan", "none",
+         "--steps", str(steps), "--model", model, "--workdir", workdir,
+         "--keep", "50", "--seed", str(seed),
+         "--layout", "zero3", "--mesh", str(mesh)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, (
+        f"straight zero3 reference failed rc={out.returncode}: "
+        f"{out.stderr[-800:]}")
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+def _ckpt_rows(name: str, report: dict, straight: dict, *,
+               detect_event: str, model: str) -> list[dict]:
+    """Rows for one shard-fault drill.  Detection here is the shard
+    store's OWN (the sha256/census check at restore), not a watcher
+    poll: onset is the faulted attempt's 143 exit (the post-exit fault
+    lands at exit), detect is the first ``detect_event`` ledger row the
+    reconstruction wrote, heal is the drill-observed completion."""
+    rows_l = _ledger_rows(report["ledger"])
+    onset = next((r.get("ts") for r in rows_l
+                  if r.get("event") == "run_end"
+                  and r.get("rc") == 143), None)
+    detect = next((r for r in rows_l
+                   if r.get("event") == detect_event), None)
+    mttd = mttr = None
+    if detect is not None:
+        if onset is not None:
+            mttd = round(max(0.0, float(detect["ts"]) - float(onset))
+                         * 1000.0, 1)
+        mttr = round(max(0.0, report["t_healed"] - float(detect["ts"]))
+                     * 1000.0, 1)
+    tapes = [[(s, l) for s, l in rec.get("losses", [])]
+             for rec in report["outs"]]
+    lost = steps_lost(straight["losses"], tapes)
+    finals = [rec for rec in report["outs"]
+              if rec.get("status") == "ok"
+              and rec.get("step") == straight["step"]]
+    # Same width saver->restorer, so BOTH digests must match: the full
+    # row-state one and the width-independent materialized-params one.
+    bitwise = bool(finals) and all(
+        rec["digest"] == straight["digest"]
+        and rec.get("params_digest") == straight.get("params_digest")
+        for rec in finals)
+    if not bitwise:
+        _log(f"{name}: WARNING — final digests do not all match the "
+             f"straight run ({len(finals)} final record(s))")
+    restore_failures = sum(1 for r in rows_l
+                           if r.get("event") == "ckpt_refused")
+    mismatches = [r for r in rows_l
+                  if r.get("event") == "ckpt_digest_mismatch"]
+    rebuilt = {(r.get("step"), r.get("shard")) for r in rows_l
+               if r.get("event") == "ckpt_reconstruct"}
+    unrecovered = sum(1 for r in mismatches
+                      if (r.get("step"), r.get("shard")) not in rebuilt)
+    detail = {"platform": "cpu", "model": model, "drill": name,
+              "status": report["status"],
+              "detect_event": (detect or {}).get("event"),
+              "reconstructs": len(rebuilt),
+              "bitwise_resume": bitwise,
+              "final_records": len(finals),
+              "mttd_ms": mttd, "mttr_ms": mttr}
+    rows = []
+    for metric, value, unit in (
+            (f"heal_{name}_mttd_ms", mttd, "ms"),
+            (f"heal_{name}_mttr_ms", mttr, "ms"),
+            (f"heal_{name}_steps_lost",
+             lost if bitwise else max(lost, 1), "steps"),
+            ("ckpt_shard_restore_failures", restore_failures, "count"),
+            ("ckpt_digest_mismatch_unrecovered", unrecovered, "count")):
+        rows.append({"metric": metric, "value": value, "unit": unit,
+                     "platform": "cpu", "detail": detail})
+    return rows
+
+
+def drill_ckpt(base: str, model: str = "softmax", steps: int = 12,
+               mesh: int = 4) -> list[dict]:
+    """Shard-redundant checkpointing: a D=4 ZeRO-3 gang is preempted
+    and, after its final save, (a) one mesh-shard's whole snapshot
+    directory is deleted, then separately (b) one payload byte of one
+    shard is flipped in place.  The fleet's resume agreement still
+    votes for that step (quorum holds at R=2), the relaunch
+    reconstructs the shard from its ring mirror — detecting the rot by
+    sha256, never silently restoring it — and the finished run is
+    BITWISE the uninterrupted one.  softmax by default: the row layout
+    doesn't care about model size, and the drill stays tier-1 cheap."""
+    rows: list[dict] = []
+    straight = _straight_zero3(os.path.join(base, "straight_ckpt"),
+                               model, steps, mesh)
+    zero3 = ["--layout", "zero3", "--mesh", str(mesh)]
+    for plan, detect_event in (
+            ("shard_loss", "ckpt_reconstruct"),
+            ("bitflip", "ckpt_digest_mismatch")):
+        _log(f"ckpt: 1-process D={mesh} zero3 {model}, {plan} after "
+             f"the final save — mirror reconstruction must be bitwise")
+        wd = os.path.join(base, f"ckpt_{plan}")
+        report = _fleet_drill(wd, plan, steps, model, ranks=1,
+                              extra_argv=zero3)
+        rows += _ckpt_rows(f"ckpt_{plan}", report, straight,
+                           detect_event=detect_event, model=model)
+    return rows
+
+
+DRILLS = ("slow_rank", "nan", "host_loss", "serve_slo", "canary",
+          "ckpt")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -596,6 +719,8 @@ def main(argv: list[str] | None = None) -> int:
             rows += drill_serve_slo(args.workdir)
         elif d == "canary":
             rows += drill_canary(args.workdir)
+        elif d == "ckpt":
+            rows += drill_ckpt(args.workdir)
         _log(f"{d}: done in {time.monotonic() - t0:.1f}s")
     for row in rows:
         print(json.dumps(row, sort_keys=True), flush=True)
@@ -606,7 +731,9 @@ def main(argv: list[str] | None = None) -> int:
                 f.write(json.dumps(row, sort_keys=True) + "\n")
         os.replace(tmp, args.out)
         _log(f"record written to {args.out}")
-    bad = [r for r in rows if r["metric"].endswith("_lost")
+    bad = [r for r in rows
+           if r["metric"].endswith(("_lost", "_restore_failures",
+                                    "_unrecovered"))
            and r["value"] not in (0, 0.0)]
     obs_ledger.end_global(rc=1 if bad else 0)
     if bad:

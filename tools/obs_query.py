@@ -370,14 +370,52 @@ _HEAL_RENDER = {
 }
 # KEEP-IN-SYNC-END(heal-events)
 
+# Renderers for the shard-redundant snapshot store's ckpt_* ledger rows
+# (resilience/shardstore.py) — the checkpoint half of a job's timeline:
+# saves, elastic restores, mirror reconstructions, digest-caught rot,
+# and the loud over-redundancy refusal.  Unknown ckpt_* rows render
+# generically, same contract as the tables above.
+_CKPT_RENDER = {
+    "ckpt_save": lambda r: (
+        f"shard set saved at step {r.get('step')}: {r.get('ranks')} "
+        f"shard(s) x R={r.get('redundancy')} copies, "
+        f"{r.get('nbytes')} payload byte(s)"),
+    "ckpt_restore": lambda r: (
+        (f"ELASTIC restore at step {r.get('step')}: "
+         f"D={r.get('from_ranks')} shard set regrouped onto "
+         f"D={r.get('to_ranks')} through the engine layout pass"
+         if r.get("elastic") else
+         f"restored shard set at step {r.get('step')} "
+         f"(D={r.get('to_ranks')})")
+        + (f"; reconstructed shard(s) {r.get('reconstructed')} from "
+           f"ring mirrors" if r.get("reconstructed") else "")),
+    "ckpt_reconstruct": lambda r: (
+        f"shard {r.get('shard')} of step {r.get('step')} rebuilt from "
+        f"rank {r.get('source_rank')}'s ring mirror"),
+    "ckpt_digest_mismatch": lambda r: (
+        f"BIT ROT caught: {r.get('file')} (shard {r.get('shard')}, "
+        f"step {r.get('step')}) failed its sha256 — copy refused, "
+        f"never restored"),
+    "ckpt_copy_unreadable": lambda r: (
+        f"copy unreadable: {r.get('file')} (shard {r.get('shard')}, "
+        f"step {r.get('step')}) — trying the next ring copy"),
+    "ckpt_refused": lambda r: (
+        f"restore REFUSED at step {r.get('step')}: shard "
+        f"{r.get('shard')} has no intact copy (census "
+        f"{r.get('census')}, R={r.get('redundancy')}) — loss exceeds "
+        f"redundancy"),
+}
+
 
 def why_rows(rows: list[dict], token: str) -> tuple[str, list[dict]]:
     """Resolve ``token`` (exact id or unique prefix) against the
-    distinct job ids in the ledger's sched_* AND heal_* rows; return
-    (job_id, that job's rows in ledger order) — one timeline holding
-    the scheduler's decisions and the remediation engine's."""
+    distinct job ids in the ledger's sched_*, heal_* AND ckpt_* rows;
+    return (job_id, that job's rows in ledger order) — one timeline
+    holding the scheduler's decisions, the remediation engine's, and
+    the shard store's checkpoint events."""
     sched = [r for r in rows
-             if str(r.get("event", "")).startswith(("sched_", "heal_"))
+             if str(r.get("event", "")).startswith(("sched_", "heal_",
+                                                    "ckpt_"))
              and r.get("job")]
     jobs = []
     for r in sched:
@@ -412,7 +450,8 @@ def cmd_why(args) -> int:
                     f"({r.get('kind')}): {r.get('error')}")
         else:
             render = _WHY_RENDER.get(r.get("event")) \
-                or _HEAL_RENDER.get(r.get("event"))
+                or _HEAL_RENDER.get(r.get("event")) \
+                or _CKPT_RENDER.get(r.get("event"))
             text = (render(r) if render else
                     f"{r.get('event')}: " + json.dumps(
                         {k: v for k, v in r.items()

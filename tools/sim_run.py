@@ -29,7 +29,7 @@ Stdout is the JSON-lines record; prose on stderr.
 The scenario DSL's event kinds (the reader half — the writer table
 lives in sim/scenario.py; the digest pair keeps them honest):
 
-# KEEP-IN-SYNC(sim-scenario) digest=727dd16ed5a6
+# KEEP-IN-SYNC(sim-scenario) digest=caa363679294
 SCENARIO_EVENT_HELP = '''
   host_loss         rank's host dies (elastic: shrink; else lost)
   host_recover      lost host answers the recovery probe again
@@ -38,6 +38,7 @@ SCENARIO_EVENT_HELP = '''
   gang_crash        whole gang crashes (rcs 1 -> budgeted retry)
   gang_wedge        gang reports backend wedged (rc 3 quarantine)
   serve_load        offered serve traffic steps to a new level
+  snapshot_loss     rank's snapshot shard lost (mirror or rollback)
 '''
 # KEEP-IN-SYNC-END(sim-scenario)
 """
